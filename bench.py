@@ -1,0 +1,83 @@
+"""Headline benchmark — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Current flagship benchmark: MLP data-parallel training throughput on the
+available chip(s), methodology matching the reference's harness
+(`timeit.repeat(number=1, repeat=N)` mean over identical epochs,
+03_model_parallel.ipynb:403-423). The reference publishes no absolute
+numbers (BASELINE.md), so vs_baseline is self-relative: the first recorded
+run writes `bench_baseline.json` and subsequent runs report value/baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import numpy as np
+
+_BASELINE_FILE = pathlib.Path(__file__).parent / "bench_baseline.json"
+
+
+def _vs_baseline(metric: str, value: float) -> float:
+    baselines = {}
+    if _BASELINE_FILE.exists():
+        baselines = json.loads(_BASELINE_FILE.read_text())
+    if metric not in baselines:
+        baselines[metric] = value
+        _BASELINE_FILE.write_text(json.dumps(baselines, indent=1))
+    return round(value / baselines[metric], 3)
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    from pytorchdistributed_tpu.data import (
+        DataLoader,
+        SyntheticRegressionDataset,
+    )
+    from pytorchdistributed_tpu.models import MLP
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+    batch_size = 8192
+    model = MLP(features=(1024, 1024, 256))
+    ds = SyntheticRegressionDataset(size=batch_size * 4, in_dim=256,
+                                    out_dim=256, seed=0)
+    mesh = create_mesh()
+    trainer = Trainer(model, optax.adamw(1e-3), mse_loss, mesh=mesh,
+                      strategy="dp", log_every=10**9)
+    loader = DataLoader(ds, batch_size=batch_size, num_replicas=1, rank=0)
+
+    # Warmup (compile).
+    batch = next(iter(loader))
+    trainer.train_step(batch)
+    jax.block_until_ready(trainer.state.params)
+
+    repeats, steps = 5, 8
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for batch in loader:
+            trainer.train_step(batch)
+        for _ in range(steps - len(loader)):
+            trainer.train_step(batch)
+        jax.block_until_ready(trainer.state.params)
+        times.append(time.perf_counter() - t0)
+    mean_t = float(np.mean(times))
+    samples_per_s = batch_size * max(len(loader), steps) / mean_t
+
+    metric = "mlp_dp_training_throughput"
+    print(json.dumps({
+        "metric": metric,
+        "value": round(samples_per_s, 1),
+        "unit": "samples/s",
+        "vs_baseline": _vs_baseline(metric, samples_per_s),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Pytest bootstrap: run all tests on an 8-device CPU simulation.
+
+This is the TPU analog of the reference's "gloo CPU smoke" config
+(BASELINE.json configs[0]): `--xla_force_host_platform_device_count=8` gives
+a single process 8 XLA CPU devices, so every pjit/shard_map code path —
+including multi-chip sharding — executes without TPU hardware (SURVEY.md §4).
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+# Force CPU even when the ambient environment points JAX at a TPU
+# (JAX_PLATFORMS=axon): the suite must be hermetic and multi-"chip".
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize imports jax at interpreter startup (to
+# register the TPU tunnel backend), so JAX_PLATFORMS=axon is already baked
+# into jax.config by the time this file runs. Override it post-import —
+# legal as long as no backend has been initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on the CPU simulator"

@@ -1,0 +1,8 @@
+from pytorchdistributed_tpu.data.sampler import ShardedSampler  # noqa: F401
+from pytorchdistributed_tpu.data.loader import DataLoader, prefetch_to_device  # noqa: F401
+from pytorchdistributed_tpu.data.datasets import (  # noqa: F401
+    ArrayDataset,
+    SyntheticRegressionDataset,
+    SyntheticImageDataset,
+    SyntheticTokenDataset,
+)

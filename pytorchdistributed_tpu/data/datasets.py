@@ -1,0 +1,83 @@
+"""Hermetic synthetic datasets (the reference's test substrate).
+
+The reference verifies everything with random tensors so no download is ever
+needed (SURVEY.md §4): `MyTrainDataset` of 2048 × (rand(20), rand(1)) pairs
+(reference ddp_gpus.py:57-66) and `generate_random_data()` ImageNet-shaped
+batches (reference 03_model_parallel.ipynb cell 7). Same policy here.
+
+TPU-first design note: datasets are array-backed and indexed with *vectors* of
+indices, so a whole batch is one fancy-indexing gather on the host — no
+per-sample Python loop, no collate step.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+class ArrayDataset:
+    """Map-style dataset over a dict of equally-sized leading-dim arrays.
+
+    ``ds[indices]`` with an integer vector returns the batch dict directly.
+    """
+
+    def __init__(self, arrays: Mapping[str, np.ndarray]):
+        if not arrays:
+            raise ValueError("arrays must be non-empty")
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"mismatched leading dims: {sizes}")
+        self.arrays = dict(arrays)
+        self._size = next(iter(sizes.values()))
+
+    def __len__(self) -> int:
+        return self._size
+
+    def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class SyntheticRegressionDataset(ArrayDataset):
+    """The reference's ``MyTrainDataset``: pairs of (rand(in), rand(out))
+    (reference ddp_gpus.py:57-66; defaults 2048 × (20 → 1))."""
+
+    def __init__(self, size: int = 2048, in_dim: int = 20, out_dim: int = 1,
+                 seed: int = 0):
+        rng = np.random.default_rng(seed)
+        super().__init__({
+            "x": rng.random((size, in_dim), dtype=np.float32),
+            "y": rng.random((size, out_dim), dtype=np.float32),
+        })
+
+
+class SyntheticImageDataset(ArrayDataset):
+    """ImageNet-shaped random data (reference 03_model_parallel.ipynb cell 7:
+    3×128×128, 1000 classes) — stored NHWC, the TPU-native image layout."""
+
+    def __init__(self, size: int = 1024, image_size: int = 128,
+                 channels: int = 3, num_classes: int = 1000, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.num_classes = num_classes
+        super().__init__({
+            "image": rng.standard_normal(
+                (size, image_size, image_size, channels)).astype(np.float32),
+            "label": rng.integers(0, num_classes, (size,), dtype=np.int32),
+        })
+
+
+class SyntheticTokenDataset(ArrayDataset):
+    """Random token sequences for LM / MLM configs (BASELINE.json configs
+    3-4). ``tokens`` are inputs; ``targets`` are tokens shifted by one for
+    causal LM training."""
+
+    def __init__(self, size: int = 1024, seq_len: int = 128,
+                 vocab_size: int = 32000, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.vocab_size = vocab_size
+        toks = rng.integers(0, vocab_size, (size, seq_len + 1), dtype=np.int32)
+        super().__init__({
+            "tokens": toks[:, :-1],
+            "targets": toks[:, 1:],
+        })

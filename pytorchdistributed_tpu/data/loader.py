@@ -1,0 +1,93 @@
+"""Batched, device-fed data loading.
+
+Replaces the reference's `DataLoader(..., pin_memory=True)` + per-batch
+`.to(gpu_id)` copies (reference ddp_gpus.py:71-76, 49-50) with the TPU
+pattern: the host assembles its process-local batch with one vectorized
+gather, and `shard_batch` turns it into a *global* jax.Array laid out by a
+`NamedSharding` — `jax.device_put` single-process, or
+`jax.make_array_from_process_local_data` on a multi-host pod. A small
+double-buffered prefetcher overlaps host gather + H2D DMA with device compute
+(the role `pin_memory=True` played on CUDA).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from pytorchdistributed_tpu.data.sampler import ShardedSampler
+
+
+class DataLoader:
+    """Iterates per-process batches of a map-style array dataset.
+
+    ``batch_size`` is the per-process batch (matching torch's per-rank
+    meaning); the global batch is ``batch_size * num_replicas``. Iteration
+    order is deterministic in (seed, epoch) across processes.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        num_replicas: int | None = None,
+        rank: int | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = ShardedSampler(
+            len(dataset),
+            num_replicas if num_replicas is not None else jax.process_count(),
+            rank if rank is not None else jax.process_index(),
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+        )
+        self.drop_last = drop_last
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = self.sampler.num_samples
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        indices = self.sampler.local_indices()
+        nbatches = len(self)
+        for b in range(nbatches):
+            batch_idx = indices[b * self.batch_size : (b + 1) * self.batch_size]
+            yield self.dataset[batch_idx]
+
+
+def shard_batch(batch: dict[str, np.ndarray], sharding) -> dict[str, jax.Array]:
+    """Assemble the global device-laid-out batch from this process's shard."""
+    if jax.process_count() > 1:
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()
+        }
+    return jax.device_put(batch, sharding)
+
+
+def prefetch_to_device(
+    iterator: Iterator[dict[str, np.ndarray]],
+    sharding,
+    size: int = 2,
+) -> Iterator[dict[str, jax.Array]]:
+    """Double-buffer: keep ``size`` batches in flight on device so the H2D
+    transfer of batch k+1 overlaps the compute of batch k."""
+    queue: collections.deque = collections.deque()
+    for batch in iterator:
+        queue.append(shard_batch(batch, sharding))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -1,0 +1,87 @@
+"""Deterministic sharded sampling — the `DistributedSampler` contract.
+
+The reference relies on `DistributedSampler(train_dataset)` for disjoint
+per-rank shards ("没有任何 overlapping samples 各个 gpu 之间", reference
+ddp_gpus.py:75-76) and `sampler.set_epoch(epoch)` for a different shuffle
+every epoch (reference ddp_gpus.py:47). This module provides the same
+contract, TPU-first:
+
+  * shuffling uses `jax.random` threefry keys (stateless, identical on every
+    process given the same seed — a requirement for SPMD, where each host must
+    compute the SAME global permutation and then slice out its shard);
+  * shards are contiguous slices of the permuted index list, so a host feeding
+    N local devices can take one contiguous run and let `jax.device_put` with
+    a sharding split it further;
+  * `drop_last` or pad-to-divisible semantics match torch's
+    (pad repeats the head of the permutation, like DistributedSampler).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    """Yields the index shard for ``rank`` out of ``num_replicas``.
+
+    Deterministic in (seed, epoch): every process computes the same global
+    permutation (numpy RNG seeded with ``seed + epoch``) and takes a disjoint
+    contiguous slice. With ``drop_last=False`` the index list is padded by
+    wrapping around so every replica gets the same count.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int,
+        rank: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range [0, {num_replicas})")
+        if dataset_size <= 0:
+            raise ValueError("dataset_size must be positive")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        if drop_last:
+            self.num_samples = dataset_size // num_replicas
+        else:
+            self.num_samples = -(-dataset_size // num_replicas)  # ceil
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Re-key the shuffle (reference ddp_gpus.py:47)."""
+        self.epoch = epoch
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed * 1_000_003 + self.epoch)
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        elif self.total_size > self.dataset_size:
+            pad = self.total_size - self.dataset_size
+            indices = np.concatenate([indices, indices[:pad]])
+        return indices
+
+    def local_indices(self) -> np.ndarray:
+        """This replica's contiguous shard of the global permutation."""
+        start = self.rank * self.num_samples
+        return self._global_indices()[start : start + self.num_samples]
+
+    def __iter__(self):
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,1 @@
+from pytorchdistributed_tpu.models.mlp import MLP, LinearRegression  # noqa: F401

@@ -1,0 +1,30 @@
+"""Toy models matching the reference's demos.
+
+`LinearRegression` is the reference's training model `nn.Linear(20, 1)`
+(reference ddp_gpus.py:78); `MLP` is the 4-layer demo net from the
+DataParallel lesson (reference 01_multi_gpus_data_parallelism.ipynb cell 5).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+
+class LinearRegression(nn.Module):
+    out_dim: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.out_dim)(x)
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 256, 128, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        for f in self.features[:-1]:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.features[-1])(x)

@@ -1,0 +1,9 @@
+from pytorchdistributed_tpu.ops.collectives import (  # noqa: F401
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    all_to_all,
+    broadcast_from,
+    ppermute_ring,
+    reduce_scatter,
+)

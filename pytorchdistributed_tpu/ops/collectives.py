@@ -1,0 +1,76 @@
+"""Named collective wrappers — the framework's L0 (SURVEY.md §1).
+
+The reference's L0 is NCCL, reached through `backend="nccl"` (reference
+ddp_gpus.py:22) with ring-allreduce = scatter-reduce + all-gather explained at
+02_ddp.ipynb:33-47. On TPU there is NO userspace collective library: these are
+XLA HLO ops executed by the runtime over the ICI torus (intra-slice) or DCN
+(cross-slice), already implemented as the hardware-optimal ring/torus
+algorithms. These wrappers exist so schedules and tests can name the
+operation they mean; inside `jit` + sharding, XLA usually inserts them
+automatically, which is the TPU answer to DDP's bucketed Reducer.
+
+All functions must run inside `shard_map`/`pmap`-style contexts where the
+named axis is bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_sum(x, axis_name: str):
+    """NCCL allreduce(sum) ≙ `lax.psum` (ring-allreduce, 02_ddp.ipynb:33-47)."""
+    return lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: str):
+    """DDP's gradient averaging: allreduce(sum) / world_size."""
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    """NCCL allgather: concatenate shards along ``axis`` on every member."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, axis: int = 0):
+    """NCCL reduce-scatter: sum then keep this member's shard (the first
+    half of ring-allreduce, 02_ddp.ipynb:33-40; FSDP's gradient op)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast_from(x, axis_name: str, *, root: int = 0):
+    """NCCL broadcast: everyone takes ``root``'s value (DDP ctor's
+    rank0→all param sync, reference ddp_gpus.py:35)."""
+    idx = lax.axis_index(axis_name)
+    size = lax.axis_size(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name) if size > 1 else x
+
+
+def ppermute_ring(x, axis_name: str, *, shift: int = 1):
+    """Rotate shards around the ring: member i receives from i-shift.
+    The building block of ring attention (SURVEY.md §5) and pipelined
+    stage-boundary transfer."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):
+    """NCCL alltoall: re-shard which dimension is split across the axis
+    (Ulysses-style head↔sequence redistribution)."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True,
+    )
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)

@@ -1,0 +1,6 @@
+from pytorchdistributed_tpu.parallel.sharding import (  # noqa: F401
+    fsdp_param_shardings,
+    replicated_shardings,
+    shardings_for_strategy,
+)
+from pytorchdistributed_tpu.parallel.precision import Policy  # noqa: F401

@@ -1,0 +1,52 @@
+"""Mixed precision — the amp→bf16 port (BASELINE.json north star).
+
+CUDA amp (GradScaler + fp16 autocast) does not map to TPU: the MXU's native
+wide type is bfloat16, which shares float32's exponent range, so no loss
+scaling is needed. The policy is therefore just a dtype triple: keep master
+params in fp32, run compute (matmuls/convs on the MXU) in bf16, accumulate
+reductions in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floating(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """param_dtype: master copy; compute_dtype: forward/backward math;
+    output_dtype: loss/metrics accumulation."""
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    @staticmethod
+    def bf16() -> "Policy":
+        """The TPU mixed-precision default (amp equivalent)."""
+        return Policy(compute_dtype=jnp.bfloat16)
+
+    @staticmethod
+    def full() -> "Policy":
+        return Policy()
+
+    def cast_params_for_compute(self, params):
+        return _cast_floating(params, self.compute_dtype)
+
+    def cast_batch(self, batch):
+        return _cast_floating(batch, self.compute_dtype)
+
+    def cast_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)

@@ -1,0 +1,64 @@
+"""Parameter-sharding rules: DDP/FSDP/TP as PartitionSpec choices.
+
+This is the framework's L3 (SURVEY.md §1), replacing the reference's wrapper
+classes: `DDP(model, ...)` (reference ddp_gpus.py:35) becomes "params
+replicated, batch sharded on the data axes"; FSDP/ZeRO-3 (BASELINE.json
+north star) becomes "each param's largest divisible dim sharded on the fsdp
+axis"; Megatron TP becomes explicit per-layer logical axis annotations
+(see parallel/tp.py). XLA then inserts the all-gather / reduce-scatter /
+psum traffic that torch implements in the DDP Reducer and FSDP runtime.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+
+def replicated_shardings(params, mesh: Mesh):
+    """DDP: every parameter fully replicated (grad sync happens because the
+    batch is sharded and XLA psums the grads)."""
+    return jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
+
+
+def _fsdp_spec(shape, fsdp_size: int, *, min_weight_size: int) -> P:
+    """Shard the largest dim divisible by ``fsdp_size``; replicate tiny
+    params (biases, norms) where sharding would only add latency."""
+    if int(np.prod(shape)) < min_weight_size:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for i in order:
+        if shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size:
+            spec = [None] * len(shape)
+            spec[i] = Axis.FSDP
+            return P(*spec)
+    return P()
+
+
+def fsdp_param_shardings(params, mesh: Mesh, *, min_weight_size: int = 2**14):
+    """ZeRO-3-style sharding over the "fsdp" mesh axis (BASELINE north star:
+    "FSDP's all-gather/reduce-scatter ... ported to XLA collectives")."""
+    fsdp_size = mesh.shape[Axis.FSDP]
+    if fsdp_size == 1:
+        return replicated_shardings(params, mesh)
+
+    def spec(leaf):
+        return NamedSharding(
+            mesh, _fsdp_spec(leaf.shape, fsdp_size,
+                             min_weight_size=min_weight_size)
+        )
+
+    return jax.tree.map(spec, params)
+
+
+def shardings_for_strategy(strategy: str, params, mesh: Mesh):
+    """Map a named strategy (the reference's wrapper-class choice) onto
+    PartitionSpecs for the same single train step."""
+    if strategy in ("dp", "ddp"):
+        return replicated_shardings(params, mesh)
+    if strategy in ("fsdp", "zero3"):
+        return fsdp_param_shardings(params, mesh)
+    raise ValueError(f"unknown strategy {strategy!r}; use 'dp' or 'fsdp'")

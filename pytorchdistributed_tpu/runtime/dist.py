@@ -1,0 +1,135 @@
+"""Process-group lifecycle — the TPU-native `init_process_group`.
+
+The reference initializes distributed training two ways (SURVEY.md §3):
+
+  * spawn-style: explicit ``rank``/``world_size`` args plus
+    ``MASTER_ADDR``/``MASTER_PORT`` env (reference ddp_gpus.py:11-23), and
+  * torchrun-style: everything from the env contract
+    ``RANK/WORLD_SIZE/LOCAL_RANK/MASTER_ADDR/MASTER_PORT``
+    (reference ddp_gpus_torchrun.py:11-19).
+
+On TPU there is no userspace collective library to boot: rendezvous is
+`jax.distributed.initialize` (coordinator address + process id), after which
+XLA collectives over ICI/DCN just work. This module supports both reference
+entry styles on top of that, resolving, in priority order:
+
+  1. explicit arguments,
+  2. the torchrun env contract (so launch scripts port unchanged),
+  3. JAX/TPU automatic slice-metadata discovery (args all None on a pod).
+
+Single-process runs (one host, 1..N local devices — including CPU simulation)
+skip `jax.distributed.initialize` entirely, mirroring how the reference's CPU
+"gloo smoke" config needs no NCCL.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass
+class _ProcessGroupState:
+    # rank/world_size are intentionally NOT cached here: jax.process_index()
+    # / jax.process_count() are the single source of truth after init.
+    initialized: bool = False
+    multiprocess: bool = False
+    local_rank: int = 0
+
+
+_state = _ProcessGroupState()
+
+
+def _env_int(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None
+
+
+def init_process_group(
+    *,
+    coordinator_address: str | None = None,
+    world_size: int | None = None,
+    rank: int | None = None,
+    local_device_ids: list[int] | None = None,
+) -> None:
+    """Initialize the distributed runtime (idempotent).
+
+    Mirrors the contract of the reference's ``ddp_setup``
+    (ddp_gpus.py:11-23 / ddp_gpus_torchrun.py:11-19): explicit args win,
+    otherwise the torchrun env contract, otherwise TPU auto-discovery.
+    """
+    if _state.initialized:
+        return
+
+    # torchrun-style env contract (reference ddp_gpus_torchrun.py:14-19).
+    # NB: rank 0 is falsy — only a None env lookup may fall through.
+    if rank is None:
+        rank = _env_int("RANK")
+    if rank is None:
+        rank = _env_int("PROCESS_ID")
+    if world_size is None:
+        world_size = _env_int("WORLD_SIZE")
+    if world_size is None:
+        world_size = _env_int("NUM_PROCESSES")
+    if coordinator_address is None:
+        addr = os.environ.get("MASTER_ADDR") or os.environ.get(
+            "COORDINATOR_ADDRESS"
+        )
+        if addr:
+            port = os.environ.get("MASTER_PORT", "12355")
+            coordinator_address = addr if ":" in addr else f"{addr}:{port}"
+
+    multiprocess = (world_size or 1) > 1 or coordinator_address is not None
+    if multiprocess:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=world_size,
+            process_id=rank,
+            local_device_ids=local_device_ids,
+        )
+    local_rank = _env_int("LOCAL_RANK")
+    _state.local_rank = 0 if local_rank is None else local_rank
+    _state.multiprocess = multiprocess
+    _state.initialized = True
+
+
+def destroy_process_group() -> None:
+    """Tear down the runtime (reference ddp_gpus.py:83)."""
+    if _state.multiprocess:
+        jax.distributed.shutdown()
+    _state.initialized = False
+    _state.multiprocess = False
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def get_rank() -> int:
+    """Process rank (the reference's ``rank``, 02_ddp.ipynb cell 1)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of processes (the reference's ``world_size``)."""
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return _state.local_rank
+
+
+def is_main_process() -> bool:
+    """True on the rank responsible for logging/checkpoint metadata (the
+    reference prints from every rank — SURVEY.md §5 flags that as a wart)."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

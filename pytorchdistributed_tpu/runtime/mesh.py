@@ -1,0 +1,176 @@
+"""Device-mesh construction — the TPU-native replacement for process groups.
+
+The reference reaches parallelism by wrapping models per-strategy
+(`DDP(model, device_ids=[gpu_id])`, reference ddp_gpus.py:35; manual two-stage
+placement, 03_model_parallel.ipynb cell 5). On TPU the idiomatic equivalent is
+ONE `jax.sharding.Mesh` whose named axes encode every strategy at once:
+
+    axis        strategy                      collective traffic
+    ----        --------                      ------------------
+    "data"      DDP-style data parallelism    grad psum (ICI, or DCN across slices)
+    "fsdp"      ZeRO-3 param/opt sharding     all-gather / reduce-scatter (ICI)
+    "tensor"    Megatron tensor parallelism   activation psum (fastest ICI axis)
+    "pipe"      pipeline stages               ppermute stage boundaries
+    "seq"       sequence/context parallelism  ppermute (ring attention) / all_to_all
+
+Axis ordering matters on hardware: `mesh_utils.create_device_mesh` lays axes
+onto the ICI torus so the *last* axes get the tightest physical neighborhoods.
+We therefore order (data, fsdp, pipe, seq, tensor) — tensor parallelism is the
+most latency-sensitive, data parallelism tolerates DCN. For multi-slice pods,
+`create_hybrid_device_mesh` pins the "data" axis to DCN (SURVEY.md §5
+"Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Axis:
+    """Canonical mesh-axis names used across the framework."""
+
+    DATA = "data"
+    FSDP = "fsdp"
+    TENSOR = "tensor"
+    PIPE = "pipe"
+    SEQ = "seq"
+    EXPERT = "expert"
+
+    # Order = DCN-most-tolerant first, ICI-latency-hungriest last.
+    ALL = (DATA, FSDP, EXPERT, PIPE, SEQ, TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each parallelism axis. ``-1`` on exactly one axis means
+    "absorb all remaining devices" (like the reference's
+    ``world_size = torch.cuda.device_count()``, ddp_gpus.py:94).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    pipe: int = 1
+    seq: int = 1
+    tensor: int = 1
+    # Number of pod slices connected over DCN. >1 selects the hybrid
+    # (ICI x DCN) mesh; the "data" axis then spans DCN.
+    num_slices: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            Axis.DATA: self.data,
+            Axis.FSDP: self.fsdp,
+            Axis.EXPERT: self.expert,
+            Axis.PIPE: self.pipe,
+            Axis.SEQ: self.seq,
+            Axis.TENSOR: self.tensor,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Resolve -1 entries against the device count; validate the product."""
+        sizes = self.sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one axis may be -1, got {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices but {n_devices} are available"
+            )
+        return sizes
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[Any] | None = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build the framework's device mesh.
+
+    ``create_mesh()`` → all devices on the "data" axis (pure DDP).
+    ``create_mesh(tensor=4)`` → remaining devices on "data", 4-way TP.
+    ``create_mesh(MeshConfig(num_slices=2, fsdp=8))`` → hybrid DCN mesh.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        config = dataclasses.replace(config, **axis_sizes)
+
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in Axis.ALL)
+
+    if config.num_slices > 1:
+        # Multi-slice: the "data" axis rides DCN, everything else stays on the
+        # ICI torus within a slice.
+        if sizes[Axis.DATA] % config.num_slices != 0:
+            raise ValueError(
+                f"data axis {sizes[Axis.DATA]} must be a multiple of "
+                f"num_slices {config.num_slices}"
+            )
+        per_slice = list(shape)
+        per_slice[0] = sizes[Axis.DATA] // config.num_slices
+        dcn = [1] * len(shape)
+        dcn[0] = config.num_slices
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices, allow_split_physical_axes=True
+        )
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, NotImplementedError):
+            # CPU-sim / host platforms without topology info: plain reshape.
+            device_array = np.asarray(devices).reshape(shape)
+
+    return Mesh(device_array, Axis.ALL)
+
+
+def local_mesh(n: int | None = None) -> Mesh:
+    """Mesh over this process's addressable devices only (single-host runs,
+    CPU simulation via --xla_force_host_platform_device_count)."""
+    devices = jax.local_devices()
+    if n is not None:
+        devices = devices[:n]
+    return create_mesh(MeshConfig(), devices=devices)
+
+
+def mesh_shape(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
+    """Sharding for a [batch, ...] array: batch split over every
+    data-parallel-like axis (data+fsdp), optionally sequence dim over "seq"."""
+    batch_axes = tuple(
+        a for a in (Axis.DATA, Axis.FSDP) if mesh.shape[a] > 1
+    ) or (Axis.DATA,)
+    if seq_axis and mesh.shape[Axis.SEQ] > 1:
+        return NamedSharding(mesh, P(batch_axes, Axis.SEQ))
+    return NamedSharding(mesh, P(batch_axes))
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape[Axis.DATA] * mesh.shape[Axis.FSDP]

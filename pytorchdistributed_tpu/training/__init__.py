@@ -1,0 +1,6 @@
+from pytorchdistributed_tpu.training.trainer import Trainer, TrainState  # noqa: F401
+from pytorchdistributed_tpu.training.losses import (  # noqa: F401
+    cross_entropy_loss,
+    mse_loss,
+    token_cross_entropy_loss,
+)

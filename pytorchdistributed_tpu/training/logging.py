@@ -1,0 +1,38 @@
+"""Rank-0 structured logging (SURVEY.md §5 "Metrics / logging").
+
+The reference prints from every rank, interleaving output
+(02_ddp.ipynb:252-266). Here: a stdlib logger that only emits on the main
+process, plus a tiny metric formatter. Heavier sinks (TensorBoard via
+`jax.profiler`) attach in utils/profiling.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+_FMT = "[%(asctime)s rank{rank}] %(message)s"
+
+
+class MetricLogger:
+    def __init__(self, name: str = "tpu-dist"):
+        self._log = logging.getLogger(name)
+        if not self._log.handlers:
+            h = logging.StreamHandler(sys.stdout)
+            h.setFormatter(
+                logging.Formatter(
+                    _FMT.format(rank=jax.process_index()), "%H:%M:%S"
+                )
+            )
+            self._log.addHandler(h)
+            self._log.setLevel(logging.INFO)
+            self._log.propagate = False
+
+    def info(self, msg: str) -> None:
+        self._log.info(msg)
+
+    def log_step(self, epoch: int, step: int, metrics: dict[str, float]) -> None:
+        parts = " ".join(f"{k}={v:.4g}" for k, v in metrics.items())
+        self._log.info(f"epoch {epoch} step {step} | {parts}")

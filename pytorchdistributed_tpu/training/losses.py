@@ -1,0 +1,44 @@
+"""Loss functions for the built-in task shapes.
+
+The reference's only loss is `F.cross_entropy` / MSE-style regression in the
+DDP hot loop (reference ddp_gpus.py:37-42). Losses here are mean-reduced over
+the *global* batch: under a sharded batch inside `jit`, the mean lowers to a
+local partial sum + `psum` — exactly DDP's gradient-averaging semantics
+without a Reducer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def mse_loss(model, params, batch, rng=None):
+    pred = model.apply(params, batch["x"])
+    loss = jnp.mean((pred - batch["y"]) ** 2)
+    return loss, {"loss": loss}
+
+
+def cross_entropy_loss(model, params, batch, rng=None):
+    """Image classification: batch = {image, label}."""
+    logits = model.apply(params, batch["image"])
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["label"]
+    ).mean()
+    acc = (logits.argmax(-1) == batch["label"]).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def token_cross_entropy_loss(model, params, batch, rng=None):
+    """LM: batch = {tokens, targets}; optional {loss_mask} for MLM."""
+    logits = model.apply(params, batch["tokens"])
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["targets"]
+    )
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        ce = jnp.where(mask, ce, 0.0)
+        loss = ce.sum() / jnp.maximum(mask.sum(), 1)
+    else:
+        loss = ce.mean()
+    return loss, {"loss": loss}

@@ -1,0 +1,222 @@
+"""The Trainer — L5 of the layer map (SURVEY.md §1).
+
+Same API shape as the reference's `Trainer` class (`__init__ / _run_batch /
+_run_epoch / train`, reference ddp_gpus.py:25-55), rebuilt around one jitted
+SPMD train step:
+
+  * the hot loop `zero_grad → forward → loss → backward → step`
+    (reference ddp_gpus.py:37-42) is a single `jax.jit`-compiled function of
+    (state, batch) → (state, metrics) with donated state;
+  * DDP's bucketed-Reducer gradient allreduce (reference ddp_gpus.py:35) is
+    implicit: the batch is sharded over the data axes, so XLA emits and
+    overlaps the gradient psum itself;
+  * FSDP is the same step with parameter shardings from
+    `fsdp_param_shardings` — XLA inserts all-gather/reduce-scatter;
+  * `sampler.set_epoch` reshuffling (reference ddp_gpus.py:47) is driven by
+    `fit`.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorchdistributed_tpu.data.loader import prefetch_to_device
+from pytorchdistributed_tpu.parallel.precision import Policy
+from pytorchdistributed_tpu.parallel.sharding import shardings_for_strategy
+from pytorchdistributed_tpu.runtime import dist
+from pytorchdistributed_tpu.runtime.mesh import batch_sharding, create_mesh
+from pytorchdistributed_tpu.training.logging import MetricLogger
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+class Trainer:
+    """``Trainer(model, optimizer, loss_fn).fit(loader, max_epochs)``.
+
+    ``strategy`` selects the parallelism the reference reaches via wrapper
+    classes: "dp" (replicated params ≙ DDP) or "fsdp" (ZeRO-3 sharding).
+    ``precision=Policy.bf16()`` is the amp→bf16 port; ``remat=True`` enables
+    activation checkpointing (GPipe's "time for space",
+    03_model_parallel.ipynb:637-643).
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Callable,
+        *,
+        mesh=None,
+        strategy: str = "dp",
+        precision: Policy | None = None,
+        remat: bool = False,
+        log_every: int = 10,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else create_mesh()
+        self.strategy = strategy
+        self.precision = precision or Policy.full()
+        self.remat = remat
+        self.log_every = log_every
+        self.logger = MetricLogger()
+        self._loss_fn = loss_fn
+        self.state: TrainState | None = None
+        self.state_shardings = None
+        self._step_fn = None
+        self.batch_sharding = batch_sharding(self.mesh)
+
+    # -- initialization ----------------------------------------------------
+
+    def init(self, sample_batch, seed: int = 0) -> TrainState:
+        """Create the (possibly sharded) TrainState without ever
+        materializing unsharded params on one device."""
+
+        def make_state(rng, batch):
+            params = self.model.init(rng, *self._model_args(batch))
+            opt_state = self.optimizer.init(params)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=opt_state,
+            )
+
+        rng = jax.random.key(seed)
+        abstract = jax.eval_shape(make_state, rng, sample_batch)
+        param_sh = shardings_for_strategy(
+            self.strategy, abstract.params, self.mesh
+        )
+        self.state_shardings = TrainState(
+            step=NamedSharding(self.mesh, P()),
+            params=param_sh,
+            opt_state=_opt_state_shardings(
+                abstract.opt_state, abstract.params, param_sh, self.mesh
+            ),
+        )
+        with jax.set_mesh(self.mesh):
+            self.state = jax.jit(
+                make_state, out_shardings=self.state_shardings
+            )(rng, sample_batch)
+        self._step_fn = self._build_step()
+        return self.state
+
+    def _model_args(self, batch):
+        for key in ("x", "image", "tokens"):
+            if key in batch:
+                return (batch[key],)
+        raise ValueError(f"cannot infer model input from batch keys {list(batch)}")
+
+    # -- the jitted hot loop ----------------------------------------------
+
+    def _build_step(self):
+        policy = self.precision
+        loss_fn = self._loss_fn
+        if self.remat:
+            loss_fn = jax.checkpoint(loss_fn, static_argnums=(0,))
+
+        def step(state: TrainState, batch):
+            # Derive the per-step rng on device from state.step — a host-side
+            # int(state.step) here would block on the previous step and
+            # serialize the hot loop, defeating the prefetcher's overlap.
+            rng = jax.random.fold_in(jax.random.key(1_234_567), state.step)
+
+            def compute_loss(params):
+                cparams = policy.cast_params_for_compute(params)
+                cbatch = policy.cast_batch(batch)
+                loss, metrics = loss_fn(self.model, cparams, cbatch, rng)
+                return loss.astype(jnp.float32), metrics
+
+            (_, metrics), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+            # Grads arrive in compute dtype; master update stays fp32.
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), grads, state.params
+            )
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            metrics = {k: v.astype(jnp.float32) for k, v in metrics.items()}
+            return new_state, metrics
+
+        return jax.jit(
+            step,
+            in_shardings=(self.state_shardings, self.batch_sharding),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    def train_step(self, batch) -> dict[str, float]:
+        """One optimizer step (the reference's ``_run_batch``)."""
+        if self.state is None:
+            self.init(batch)
+        self.state, metrics = self._step_fn(self.state, batch)
+        return metrics
+
+    # -- epochs ------------------------------------------------------------
+
+    def run_epoch(self, loader, epoch: int) -> dict[str, float]:
+        """The reference's ``_run_epoch`` (ddp_gpus.py:44-51), without its
+        extra-batch-fetch wart (SURVEY.md §3.1)."""
+        loader.set_epoch(epoch)
+        if dist.is_main_process():
+            self.logger.info(
+                f"epoch {epoch} | steps {len(loader)} | "
+                f"per-process batch {loader.batch_size}"
+            )
+        metrics = {}
+        it = prefetch_to_device(iter(loader), self.batch_sharding)
+        for i, batch in enumerate(it):
+            if self.state is None:
+                self.init(batch)
+            metrics = self.train_step(batch)
+            if (i + 1) % self.log_every == 0 and dist.is_main_process():
+                vals = {k: float(v) for k, v in metrics.items()}
+                self.logger.log_step(epoch, i + 1, vals)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def fit(self, loader, max_epochs: int) -> dict[str, float]:
+        """The reference's ``train`` (ddp_gpus.py:53-55)."""
+        metrics = {}
+        for epoch in range(max_epochs):
+            t0 = time.perf_counter()
+            metrics = self.run_epoch(loader, epoch)
+            if dist.is_main_process():
+                self.logger.info(
+                    f"epoch {epoch} done in {time.perf_counter() - t0:.2f}s "
+                    f"| {metrics}"
+                )
+        return metrics
+
+
+def _opt_state_shardings(abstract_opt_state, abstract_params, param_shardings,
+                         mesh):
+    """Optimizer slots that mirror a parameter (momentum, adam m/v) inherit
+    its sharding — that is ZeRO's optimizer-state partitioning. Anything
+    else (step counters) is replicated."""
+    flat_params, _ = jax.tree.flatten(abstract_params)
+    flat_shard, _ = jax.tree.flatten(param_shardings)
+    by_shape = {}
+    for p, s in zip(flat_params, flat_shard):
+        by_shape.setdefault((p.shape, p.dtype), s)
+
+    def pick(leaf):
+        key = (leaf.shape, getattr(leaf, "dtype", None))
+        return by_shape.get(key, NamedSharding(mesh, P()))
+
+    return jax.tree.map(pick, abstract_opt_state)

@@ -1,0 +1,135 @@
+"""Data-layer tests: the DistributedSampler contract (SURVEY.md §7 step 2)."""
+
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu.data import (
+    ArrayDataset,
+    DataLoader,
+    ShardedSampler,
+    SyntheticImageDataset,
+    SyntheticRegressionDataset,
+    SyntheticTokenDataset,
+)
+
+
+class TestShardedSampler:
+    def test_disjoint_and_complete_partition(self):
+        # "no overlapping samples between gpus" (reference ddp_gpus.py:75).
+        shards = [
+            set(ShardedSampler(100, 4, r, drop_last=True))
+            for r in range(4)
+        ]
+        all_idx = set().union(*shards)
+        assert sum(len(s) for s in shards) == 100
+        assert len(all_idx) == 100
+
+    def test_padding_when_not_divisible(self):
+        samplers = [ShardedSampler(10, 4, r) for r in range(4)]
+        assert all(len(s) == 3 for s in samplers)
+        union = set().union(*(set(s) for s in samplers))
+        assert union == set(range(10))  # every sample appears
+
+    def test_drop_last_truncates(self):
+        s = ShardedSampler(10, 4, 0, drop_last=True)
+        assert len(s) == 2
+
+    def test_set_epoch_reshuffles(self):
+        s = ShardedSampler(64, 2, 0, seed=7)
+        e0 = s.local_indices().tolist()
+        s.set_epoch(1)
+        e1 = s.local_indices().tolist()
+        assert e0 != e1
+
+    def test_deterministic_across_replicas(self):
+        # Every rank must derive the SAME global permutation (SPMD
+        # requirement), differing only in the slice it takes.
+        a = ShardedSampler(64, 2, 0, seed=3)._global_indices()
+        b = ShardedSampler(64, 2, 1, seed=3)._global_indices()
+        np.testing.assert_array_equal(a, b)
+
+    def test_no_shuffle_is_arange(self):
+        s = ShardedSampler(8, 2, 1, shuffle=False)
+        assert s.local_indices().tolist() == [4, 5, 6, 7]
+
+    def test_bad_rank_raises(self):
+        with pytest.raises(ValueError):
+            ShardedSampler(8, 2, 2)
+
+
+class TestDatasets:
+    def test_regression_shapes(self):
+        # The reference MyTrainDataset contract (ddp_gpus.py:57-66).
+        ds = SyntheticRegressionDataset(size=2048, in_dim=20, out_dim=1)
+        assert len(ds) == 2048
+        batch = ds[np.array([0, 5, 7])]
+        assert batch["x"].shape == (3, 20)
+        assert batch["y"].shape == (3, 1)
+
+    def test_image_dataset_nhwc(self):
+        ds = SyntheticImageDataset(size=16, image_size=32)
+        b = ds[np.arange(4)]
+        assert b["image"].shape == (4, 32, 32, 3)
+        assert b["label"].dtype == np.int32
+
+    def test_token_dataset_shift(self):
+        ds = SyntheticTokenDataset(size=4, seq_len=16)
+        b = ds[np.arange(4)]
+        np.testing.assert_array_equal(b["tokens"][:, 1:], b["targets"][:, :-1])
+
+    def test_mismatched_arrays_raise(self):
+        with pytest.raises(ValueError):
+            ArrayDataset({"a": np.zeros((3, 2)), "b": np.zeros((4, 2))})
+
+
+class TestDataLoader:
+    def test_batches_and_len(self):
+        ds = SyntheticRegressionDataset(size=64, in_dim=4, out_dim=1)
+        dl = DataLoader(ds, batch_size=8, num_replicas=2, rank=0)
+        batches = list(dl)
+        assert len(batches) == len(dl) == 4
+        assert batches[0]["x"].shape == (8, 4)
+
+    def test_epoch_changes_batches(self):
+        ds = SyntheticRegressionDataset(size=64, in_dim=4, out_dim=1)
+        dl = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=1)
+        first = next(iter(dl))["x"]
+        dl.set_epoch(1)
+        second = next(iter(dl))["x"]
+        assert not np.array_equal(first, second)
+
+    def test_replicas_see_disjoint_data(self):
+        ds = SyntheticRegressionDataset(size=64, in_dim=4, out_dim=1)
+        seen = []
+        for rank in range(2):
+            dl = DataLoader(ds, batch_size=8, num_replicas=2, rank=rank)
+            seen.append(
+                {tuple(row) for batch in dl for row in batch["x"]}
+            )
+        assert not (seen[0] & seen[1])
+
+
+class TestDeviceFeeding:
+    def test_shard_batch_lays_out_on_mesh(self):
+        import jax
+        from pytorchdistributed_tpu.data.loader import shard_batch
+        from pytorchdistributed_tpu.runtime.mesh import batch_sharding, create_mesh
+
+        mesh = create_mesh()
+        ds = SyntheticRegressionDataset(size=64, in_dim=4, out_dim=1)
+        dl = DataLoader(ds, batch_size=16, num_replicas=1, rank=0)
+        dev = shard_batch(next(iter(dl)), batch_sharding(mesh))
+        assert isinstance(dev["x"], jax.Array)
+        assert len(dev["x"].sharding.device_set) == 8
+
+    def test_prefetch_preserves_order_and_count(self):
+        from pytorchdistributed_tpu.data.loader import prefetch_to_device
+        from pytorchdistributed_tpu.runtime.mesh import batch_sharding, create_mesh
+
+        mesh = create_mesh()
+        ds = SyntheticRegressionDataset(size=64, in_dim=4, out_dim=1)
+        dl = DataLoader(ds, batch_size=8, num_replicas=1, rank=0)
+        host = [b["x"] for b in dl]
+        dev = [b["x"] for b in prefetch_to_device(iter(dl), batch_sharding(mesh))]
+        assert len(dev) == len(host)
+        np.testing.assert_allclose(np.asarray(dev[0]), host[0])

@@ -1,0 +1,34 @@
+"""Process-group lifecycle tests (reference ddp_setup contract)."""
+
+import pytorchdistributed_tpu as ptd
+from pytorchdistributed_tpu.runtime import dist
+
+
+def test_single_process_init_and_teardown():
+    ptd.init_process_group()
+    assert ptd.is_initialized()
+    assert ptd.get_rank() == 0
+    assert ptd.get_world_size() == 1
+    assert dist.is_main_process()
+    dist.barrier()  # no-op single-process
+    ptd.destroy_process_group()
+    assert not ptd.is_initialized()
+
+
+def test_init_is_idempotent():
+    ptd.init_process_group()
+    ptd.init_process_group()
+    assert ptd.is_initialized()
+    ptd.destroy_process_group()
+
+
+def test_torchrun_env_contract(monkeypatch):
+    # Single-process values resolved from env, torchrun style
+    # (reference ddp_gpus_torchrun.py:14-19).
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("LOCAL_RANK", "0")
+    ptd.init_process_group()
+    assert ptd.get_world_size() == 1
+    assert dist.get_local_rank() == 0
+    ptd.destroy_process_group()

@@ -1,0 +1,84 @@
+"""Mesh construction tests (SURVEY.md §7 step 1)."""
+
+import jax
+import pytest
+
+from pytorchdistributed_tpu.runtime.mesh import (
+    Axis,
+    MeshConfig,
+    batch_sharding,
+    create_mesh,
+    data_parallel_size,
+    local_mesh,
+    mesh_shape,
+)
+
+
+def test_default_mesh_is_pure_data_parallel():
+    mesh = create_mesh()
+    assert mesh.shape[Axis.DATA] == len(jax.devices()) == 8
+    assert all(mesh.shape[a] == 1 for a in Axis.ALL if a != Axis.DATA)
+
+
+def test_kwarg_axis_sizes():
+    mesh = create_mesh(tensor=4)
+    assert mesh.shape[Axis.TENSOR] == 4
+    assert mesh.shape[Axis.DATA] == 2
+
+
+def test_full_config_resolution():
+    cfg = MeshConfig(data=2, fsdp=2, tensor=2)
+    sizes = cfg.resolve(8)
+    assert sizes == {
+        Axis.DATA: 2,
+        Axis.FSDP: 2,
+        Axis.EXPERT: 1,
+        Axis.PIPE: 1,
+        Axis.SEQ: 1,
+        Axis.TENSOR: 2,
+    }
+    mesh = create_mesh(cfg)
+    assert mesh_shape(mesh)[Axis.FSDP] == 2
+
+
+def test_bad_product_raises():
+    with pytest.raises(ValueError, match="devices"):
+        MeshConfig(data=3, tensor=2).resolve(8)
+
+
+def test_two_unknown_axes_raise():
+    with pytest.raises(ValueError, match="-1"):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+def test_indivisible_inference_raises():
+    with pytest.raises(ValueError, match="divisible"):
+        MeshConfig(tensor=3).resolve(8)
+
+
+def test_local_mesh_subset():
+    mesh = local_mesh(4)
+    assert mesh.devices.size == 4
+
+
+def test_batch_sharding_covers_dp_axes():
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    s = batch_sharding(mesh)
+    assert s.spec[0] == (Axis.DATA, Axis.FSDP)
+    assert data_parallel_size(mesh) == 4
+
+
+def test_batch_sharding_with_seq():
+    mesh = create_mesh(MeshConfig(data=2, seq=4))
+    s = batch_sharding(mesh, seq_axis=True)
+    assert s.spec[1] == Axis.SEQ
+
+
+def test_sharded_array_round_trip():
+    import jax.numpy as jnp
+
+    mesh = create_mesh()
+    x = jnp.arange(16.0).reshape(8, 2)
+    xs = jax.device_put(x, batch_sharding(mesh))
+    assert len(xs.sharding.device_set) == 8
+    assert (jax.device_get(xs) == jax.device_get(x)).all()

@@ -1,0 +1,118 @@
+"""End-to-end DP slice + the loss-curve equivalence test.
+
+The north star demands "identical loss curves to the NCCL path"
+(BASELINE.json); here that becomes: training with the batch sharded over 8
+devices produces the same loss curve as the same step run on one device
+(SURVEY.md §7 step 3).
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from pytorchdistributed_tpu.data import DataLoader, SyntheticRegressionDataset
+from pytorchdistributed_tpu.models import MLP, LinearRegression
+from pytorchdistributed_tpu.parallel import Policy
+from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh, local_mesh
+from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+
+def _make_loader(batch_size=32, **kw):
+    ds = SyntheticRegressionDataset(size=256, in_dim=20, out_dim=1)
+    return DataLoader(ds, batch_size=batch_size, num_replicas=1, rank=0, **kw)
+
+
+def _fit_losses(mesh, strategy="dp", epochs=2, precision=None):
+    trainer = Trainer(
+        LinearRegression(),
+        optax.sgd(1e-2),
+        mse_loss,
+        mesh=mesh,
+        strategy=strategy,
+        precision=precision,
+    )
+    loader = _make_loader()
+    losses = []
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            losses.append(trainer.train_step(batch)["loss"])
+    return np.array([float(l) for l in losses])
+
+
+def test_reference_training_job_runs():
+    """The reference's whole job (ddp_gpus.py: Linear(20,1) + SGD + MSE,
+    sharded sampler, epochs) on an 8-device mesh."""
+    mesh = create_mesh()
+    trainer = Trainer(LinearRegression(), optax.sgd(1e-3), mse_loss, mesh=mesh)
+    final = trainer.fit(_make_loader(), max_epochs=2)
+    assert np.isfinite(final["loss"])
+
+
+def test_loss_decreases():
+    losses = _fit_losses(create_mesh(), epochs=3)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_dp_equivalence_8dev_vs_1dev():
+    """Sharded-vs-single-device loss-curve equivalence (north star)."""
+    losses_8 = _fit_losses(create_mesh())
+    losses_1 = _fit_losses(local_mesh(1))
+    np.testing.assert_allclose(losses_8, losses_1, rtol=2e-5, atol=1e-6)
+
+
+def test_fsdp_matches_dp():
+    """ZeRO-3 sharding is a numerics-preserving re-layout."""
+    mlp = MLP(features=(64, 64, 1))
+    ds = SyntheticRegressionDataset(size=128, in_dim=20, out_dim=1)
+
+    def run(strategy, mesh):
+        tr = Trainer(mlp, optax.adam(1e-3), mse_loss, mesh=mesh,
+                     strategy=strategy)
+        dl = DataLoader(ds, batch_size=32, num_replicas=1, rank=0)
+        out = []
+        for batch in dl:
+            out.append(float(tr.train_step(batch)["loss"]))
+        return np.array(out)
+
+    dp = run("dp", create_mesh())
+    fsdp = run("fsdp", create_mesh(MeshConfig(data=2, fsdp=4)))
+    np.testing.assert_allclose(dp, fsdp, rtol=2e-4, atol=1e-6)
+
+
+def test_fsdp_actually_shards_params():
+    from pytorchdistributed_tpu.runtime.mesh import Axis
+
+    mesh = create_mesh(MeshConfig(data=1, fsdp=8))
+    tr = Trainer(MLP(features=(256, 256, 8)), optax.sgd(1e-2), mse_loss,
+                 mesh=mesh, strategy="fsdp", )
+    ds = SyntheticRegressionDataset(size=64, in_dim=16, out_dim=8)
+    batch = ds[np.arange(32)]
+    tr.init(batch)
+    # Dense_1 (256x256) is above the min-size-to-shard threshold;
+    # Dense_0 (16x256) is below it and stays replicated.
+    kernel = tr.state.params["params"]["Dense_1"]["kernel"]
+    assert Axis.FSDP in jax.tree.leaves(tuple(kernel.sharding.spec))
+    small = tr.state.params["params"]["Dense_0"]["kernel"]
+    assert small.sharding.spec == ()
+    # adam-free sgd: opt state trace mirrors param sharding
+    tr.train_step(batch)
+
+
+def test_bf16_policy_trains():
+    losses = _fit_losses(create_mesh(), precision=Policy.bf16(), epochs=1)
+    assert np.isfinite(losses).all()
+
+
+def test_remat_matches_no_remat():
+    mesh = create_mesh()
+    mlp = MLP(features=(32, 32, 1))
+    ds = SyntheticRegressionDataset(size=64, in_dim=8, out_dim=1)
+    batch = ds[np.arange(64)]
+
+    def one_step(remat):
+        tr = Trainer(mlp, optax.sgd(1e-2), mse_loss, mesh=mesh, remat=remat)
+        return float(tr.train_step(batch)["loss"])
+
+    assert one_step(False) == pytest.approx(one_step(True), rel=1e-6)
