@@ -1,0 +1,55 @@
+"""BERT-base masked-LM encoder (BASELINE config[2]: "BERT-base MLM, bf16").
+
+Bidirectional TransformerStack (causal=False) + the standard MLM head
+(dense → gelu → LN → tied-embedding decode). Batches follow
+data/datasets.py's MLM shape: {tokens, targets, loss_mask}.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.models.transformer import (
+    Embedder,
+    TransformerConfig,
+    TransformerStack,
+    _dense_general,
+    _layer_norm,
+)
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+
+class BertMLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.cfg
+        emb = Embedder(cfg, name="embed")
+        x = emb(tokens)
+        x = _layer_norm(cfg, "ln_embed")(x).astype(cfg.dtype)
+        x = TransformerStack(cfg, name="encoder")(
+            x, deterministic=deterministic)
+        # MLM transform head (BERT's cls/predictions/transform). Output dim
+        # logically "mlp" so TP shards it column-wise (a duplicate "embed"
+        # pair would map to an invalid duplicate mesh axis).
+        x = _dense_general(cfg.embed_dim, (Logical.EMBED, Logical.MLP), cfg,
+                           "mlm_dense")(x)
+        x = nn.gelu(x)
+        x = _layer_norm(cfg, "mlm_ln")(x)
+        logits = emb.attend(x)
+        return logits.astype(jnp.float32)
+
+
+def bert_config(size: str = "base", **overrides) -> TransformerConfig:
+    presets = {
+        "test": dict(num_layers=2, embed_dim=64, num_heads=4,
+                     vocab_size=128, max_seq_len=128),
+        "base": dict(num_layers=12, embed_dim=768, num_heads=12),
+        "large": dict(num_layers=24, embed_dim=1024, num_heads=16),
+    }
+    kw = dict(vocab_size=30522, max_seq_len=512, causal=False)
+    kw.update(presets[size])
+    kw.update(overrides)
+    return TransformerConfig(**kw)

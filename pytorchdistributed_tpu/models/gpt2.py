@@ -1,0 +1,61 @@
+"""GPT-2 causal LM (BASELINE config[3]: "GPT-2-medium FSDP + activation
+checkpointing").
+
+The reference's only LLM contact is the failed LLaMA auto-shard cell
+(reference 03_model_parallel.ipynb:86-89); this is the working TPU-native
+replacement, built on the shared TransformerStack so every parallel strategy
+(DP/FSDP/TP/ring-attention SP) applies unmodified.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.models.transformer import (
+    Embedder,
+    TransformerConfig,
+    TransformerStack,
+    _layer_norm,
+)
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+
+class GPT2(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.cfg
+        emb = Embedder(cfg, name="embed")
+        x = emb(tokens)
+        x = TransformerStack(cfg, name="h")(x, deterministic=deterministic)
+        x = _layer_norm(cfg, "ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = emb.attend(x)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    (Logical.EMBED, Logical.VOCAB)),
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:
+    """Standard GPT-2 family sizes (124M/355M/774M/1.5B)."""
+    presets = {
+        "test": dict(num_layers=2, embed_dim=64, num_heads=4, vocab_size=128,
+                     max_seq_len=128),
+        "small": dict(num_layers=12, embed_dim=768, num_heads=12),
+        "medium": dict(num_layers=24, embed_dim=1024, num_heads=16),
+        "large": dict(num_layers=36, embed_dim=1280, num_heads=20),
+        "xl": dict(num_layers=48, embed_dim=1600, num_heads=25),
+    }
+    kw = dict(vocab_size=50257, max_seq_len=1024, causal=True)
+    kw.update(presets[size])
+    kw.update(overrides)
+    return TransformerConfig(**kw)

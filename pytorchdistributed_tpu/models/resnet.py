@@ -1,0 +1,171 @@
+"""ResNet family — ResNet-18 (BASELINE config[0] CIFAR smoke) and ResNet-50
+(config[1] ImageNet DDP; the model the reference's model/pipeline-parallel
+lesson splits across GPUs, reference 03_model_parallel.ipynb:325-349).
+
+TPU-first choices: NHWC layout (XLA:TPU's native conv layout), bf16 compute
+with fp32 normalization statistics, and **stateless sync batch norm**: the
+norm uses the current batch's statistics, and because the batch is sharded
+inside jit the `jnp.mean` over the batch axis lowers to a cross-chip psum —
+torch's SyncBatchNorm wrapper with zero framework code. (No mutable
+running-average collection: keeps the train step a pure function; an
+inference-time EMA can be layered on top via optax.ema.)
+
+Stages are named so the pipeline partitioner (parallel/pipeline.py) can cut
+the network at stage boundaries, mirroring the reference's two-stage manual
+split (seq1=conv1..layer2 / seq2=layer3..fc, 03_model_parallel.ipynb:336-344).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+
+def _conv(features, kernel, strides, cfg, name):
+    return nn.Conv(
+        features, kernel, strides=strides, padding="SAME", use_bias=False,
+        dtype=cfg.dtype, param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.he_normal(),
+            (None, None, Logical.CONV_IN, Logical.CONV_OUT)),
+        name=name,
+    )
+
+
+class SyncBatchNorm(nn.Module):
+    """Normalize by the *global* batch statistics (fp32). With the batch
+    sharded over data axes, XLA turns the means into psums — the TPU-native
+    SyncBatchNorm."""
+
+    epsilon: float = 1e-5
+    zero_init_scale: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init() if self.zero_init_scale
+                else nn.initializers.ones_init(),
+                (Logical.CONV_OUT,)),
+            (c,), jnp.float32)
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (Logical.CONV_OUT,)),
+            (c,), jnp.float32)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return (y * scale + bias).astype(x.dtype)
+
+
+def _bn(cfg, name, *, zero_init_scale: bool = False):
+    return SyncBatchNorm(zero_init_scale=zero_init_scale, name=name)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    num_classes: int = 1000
+    width: int = 64
+    dtype: object = jnp.bfloat16
+    # CIFAR stem: 3x3 conv, no max-pool (for 32x32 inputs).
+    cifar_stem: bool = False
+
+
+class BasicBlock(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        r = _conv(self.features, (3, 3), (self.strides,) * 2, cfg, "conv1")(x)
+        r = nn.relu(_bn(cfg, "bn1")(r))
+        r = _conv(self.features, (3, 3), (1, 1), cfg, "conv2")(r)
+        # zero-init the last BN scale: each residual branch starts as identity
+        r = _bn(cfg, "bn2", zero_init_scale=True)(r)
+        if x.shape != r.shape:
+            x = _conv(self.features, (1, 1), (self.strides,) * 2, cfg,
+                      "down_conv")(x)
+            x = _bn(cfg, "down_bn")(x)
+        return nn.relu(x + r)
+
+
+class BottleneckBlock(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        r = _conv(self.features, (1, 1), (1, 1), cfg, "conv1")(x)
+        r = nn.relu(_bn(cfg, "bn1")(r))
+        r = _conv(self.features, (3, 3), (self.strides,) * 2, cfg, "conv2")(r)
+        r = nn.relu(_bn(cfg, "bn2")(r))
+        r = _conv(self.features * 4, (1, 1), (1, 1), cfg, "conv3")(r)
+        r = _bn(cfg, "bn3", zero_init_scale=True)(r)
+        if x.shape != r.shape:
+            x = _conv(self.features * 4, (1, 1), (self.strides,) * 2, cfg,
+                      "down_conv")(x)
+            x = _bn(cfg, "down_bn")(x)
+        return nn.relu(x + r)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        if cfg.cifar_stem:
+            x = _conv(cfg.width, (3, 3), (1, 1), cfg, "stem_conv")(x)
+            x = nn.relu(_bn(cfg, "stem_bn")(x))
+        else:
+            x = _conv(cfg.width, (7, 7), (2, 2), cfg, "stem_conv")(x)
+            x = nn.relu(_bn(cfg, "stem_bn")(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        block = BottleneckBlock if cfg.bottleneck else BasicBlock
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for b in range(n_blocks):
+                x = block(
+                    cfg,
+                    features=cfg.width * 2**stage,
+                    strides=2 if b == 0 and stage > 0 else 1,
+                    name=f"stage{stage + 1}_block{b}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global avg pool
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (Logical.EMBED, None)),
+            name="fc",
+        )(x)
+
+
+def resnet18(num_classes: int = 1000, *, cifar_stem: bool = False,
+             **kw) -> ResNet:
+    return ResNet(ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                               num_classes=num_classes,
+                               cifar_stem=cifar_stem, **kw))
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                               num_classes=num_classes, **kw))

@@ -1,0 +1,250 @@
+"""Shared Transformer core for the model zoo (GPT-2, BERT, ViT).
+
+The reference never ships a transformer (its LLaMA demo,
+03_model_parallel.ipynb:86, failed to run), but the BASELINE configs demand
+BERT-base MLM, GPT-2-medium FSDP and ViT-L/16 — so one TPU-first core serves
+all three. Design decisions (SURVEY.md §7 stance — strategies are sharding
+choices, not model rewrites):
+
+  * every parameter carries *logical* axis names via
+    `nn.with_logical_partitioning`; parallel/tp.py's rule tables map them to
+    mesh axes, so DDP/FSDP/TP/2D reuse this exact module;
+  * layers can be stacked with `nn.scan` (one compiled block body instead of
+    N inlined copies — faster XLA compiles, and the scanned "stage" axis is
+    what pipeline parallelism shards);
+  * `remat` wraps the block in `jax.checkpoint` (GPipe's activation
+    recomputation, reference 03_model_parallel.ipynb:637-643);
+  * attention backend is pluggable: "dense" | "pallas" (flash kernel) |
+    "ring" (context parallel over the seq axis) | "ulysses" (all-to-all);
+  * compute dtype bf16-by-default for the MXU; LayerNorm/softmax accumulate
+    fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.ops.attention import dense_attention
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int | None = None          # default 4*embed_dim
+    max_seq_len: int = 1024
+    causal: bool = True                 # GPT-style; False for BERT/ViT
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16         # compute dtype (MXU)
+    param_dtype: Dtype = jnp.float32
+    attention: str = "dense"            # dense | pallas | ring | ulysses
+    scan_layers: bool = True
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.mlp_dim if self.mlp_dim is not None else 4 * self.embed_dim
+
+
+def _attention_fn(kind: str) -> Callable:
+    if kind == "dense":
+        return dense_attention
+    if kind == "pallas":
+        from pytorchdistributed_tpu.ops.pallas_attention import flash_attention
+        return flash_attention
+    if kind == "ring":
+        from pytorchdistributed_tpu.ops.ring_attention import (
+            ring_attention_sharded,
+        )
+        return ring_attention_sharded
+    if kind == "ulysses":
+        from pytorchdistributed_tpu.ops.ulysses import ulysses_attention
+        return ulysses_attention
+    raise ValueError(f"unknown attention backend {kind!r}")
+
+
+def _dense_general(features: int, kernel_axes, cfg, name, *,
+                   use_bias: bool = True):
+    """Dense with logically-partitioned kernel. Head projections keep heads
+    flattened into the feature dim (kernel [embed, heads*head_dim] with
+    logical axes (embed, heads)): sharding "heads" over the tensor axis then
+    splits whole heads, the Megatron attention shard."""
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), kernel_axes[-1:]
+        ),
+        name=name,
+    )
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention with Megatron-ready head sharding."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        qkv = functools.partial(
+            _dense_general, cfg.num_heads * cfg.head_dim,
+            (Logical.EMBED, Logical.HEADS), cfg,
+        )
+
+        def heads(t):
+            t = t.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            return nn.with_logical_constraint(
+                t, (Logical.BATCH, Logical.SEQ, Logical.HEADS, Logical.KV))
+
+        q = heads(qkv(name="query")(x))
+        k = heads(qkv(name="key")(x))
+        v = heads(qkv(name="value")(x))
+
+        out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
+
+        out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        out = _dense_general(
+            cfg.embed_dim, (Logical.HEADS, Logical.EMBED), cfg, "out",
+        )(out)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class MlpBlock(nn.Module):
+    """Column-parallel wi (embed→mlp), row-parallel wo (mlp→embed): under TP
+    rules XLA emits exactly Megatron's f/g psum pattern (parallel/tp.py)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.cfg
+        h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
+                           "wi")(x)
+        h = nn.with_logical_constraint(
+            h, (Logical.BATCH, Logical.SEQ, Logical.MLP))
+        h = nn.gelu(h)
+        out = _dense_general(cfg.embed_dim, (Logical.MLP, Logical.EMBED), cfg,
+                             "wo")(h)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+def _layer_norm(cfg, name):
+    return nn.LayerNorm(
+        dtype=jnp.float32,  # normalize in fp32 regardless of compute dtype
+        param_dtype=cfg.param_dtype,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), (Logical.EMBED,)),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (Logical.EMBED,)),
+        name=name,
+    )
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.cfg
+        x = nn.with_logical_constraint(
+            x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
+        h = _layer_norm(cfg, "ln1")(x).astype(cfg.dtype)
+        x = x + SelfAttention(cfg, name="attn")(h, deterministic=deterministic)
+        h = _layer_norm(cfg, "ln2")(x).astype(cfg.dtype)
+        x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
+        return nn.with_logical_constraint(
+            x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
+
+
+class TransformerStack(nn.Module):
+    """num_layers blocks, optionally folded into one `nn.scan` whose carry is
+    the activations. The scanned parameter axis gets logical name "stage"
+    (→ mesh axis "pipe"), which is what pipeline parallelism shards."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.cfg
+        block = TransformerBlock
+        if cfg.remat:
+            block = nn.remat(
+                block, prevent_cse=not cfg.scan_layers,
+                static_argnums=(2,),  # deterministic flag
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, deterministic=deterministic),
+                                       None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: Logical.STAGE},
+            )(block(cfg, name="block"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"block_{i}")(
+                    x, deterministic=deterministic)
+        return x
+
+
+class Embedder(nn.Module):
+    """Token + learned positional embeddings; `attend` gives the tied logit
+    projection (GPT-2 weight tying)."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.tok = nn.Embed(
+            cfg.vocab_size, cfg.embed_dim,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                (Logical.VOCAB, Logical.EMBED)),
+            name="tok",
+        )
+        self.pos = self.param(
+            "pos",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, Logical.EMBED)),
+            (cfg.max_seq_len, cfg.embed_dim),
+            cfg.param_dtype,
+        )
+
+    def __call__(self, tokens):
+        seq_len = tokens.shape[1]
+        x = self.tok(tokens)
+        return x + self.pos[:seq_len].astype(self.cfg.dtype)
+
+    def attend(self, x):
+        return self.tok.attend(x.astype(self.cfg.dtype))

@@ -1,0 +1,103 @@
+"""ViT — Vision Transformer (BASELINE config[4]: "ViT-L/16 multi-host DP
+across pod slices").
+
+Patchify via a strided Conv (one big matmul for the MXU, NHWC layout),
+prepend a CLS token, run the shared bidirectional TransformerStack, classify
+from the CLS representation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerStack,
+    _layer_norm,
+)
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    transformer: TransformerConfig
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, *, deterministic: bool = True):
+        cfg, tcfg = self.cfg, self.cfg.transformer
+        p = cfg.patch_size
+        x = nn.Conv(
+            tcfg.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=tcfg.dtype, param_dtype=tcfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                (None, None, Logical.CONV_IN, Logical.EMBED)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (Logical.EMBED,)),
+            name="patch_embed",
+        )(images.astype(tcfg.dtype))
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+
+        cls = self.param(
+            "cls",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, None, Logical.EMBED)),
+            (1, 1, tcfg.embed_dim), tcfg.param_dtype,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, c)).astype(tcfg.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, Logical.EMBED)),
+            (cfg.num_patches + 1, tcfg.embed_dim), tcfg.param_dtype,
+        )
+        x = x + pos[None].astype(tcfg.dtype)
+
+        x = TransformerStack(tcfg, name="encoder")(
+            x, deterministic=deterministic)
+        x = _layer_norm(tcfg, "ln_f")(x)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=tcfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (Logical.EMBED, None)),
+            name="head",
+        )(x[:, 0])
+        return logits
+
+
+def vit_config(size: str = "base", *, image_size: int = 224,
+               patch_size: int = 16, num_classes: int = 1000,
+               **overrides) -> ViTConfig:
+    presets = {
+        "test": dict(num_layers=2, embed_dim=64, num_heads=4),
+        "base": dict(num_layers=12, embed_dim=768, num_heads=12),
+        "large": dict(num_layers=24, embed_dim=1024, num_heads=16,
+                      mlp_dim=4096),
+        "huge": dict(num_layers=32, embed_dim=1280, num_heads=16,
+                     mlp_dim=5120),
+    }
+    kw = dict(vocab_size=1, causal=False,
+              max_seq_len=(image_size // patch_size) ** 2 + 1)
+    kw.update(presets[size])
+    kw.update(overrides)
+    return ViTConfig(
+        transformer=TransformerConfig(**kw),
+        image_size=image_size, patch_size=patch_size,
+        num_classes=num_classes,
+    )

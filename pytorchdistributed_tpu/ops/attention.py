@@ -1,0 +1,50 @@
+"""Dense (reference) attention — the baseline every parallel variant is
+tested against.
+
+The reference repo contains no attention model at all (its LLaMA cell,
+03_model_parallel.ipynb:86, never ran — SURVEY.md §5 "Long-context"), so this
+is the framework's own reference implementation: numerically-stable softmax
+attention on [batch, seq, heads, head_dim] tensors, fp32 accumulation (MXU
+inputs stay bf16, sums run fp32 — parallel/precision.py policy).
+
+Sharded variants (ring, Ulysses, Pallas flash) must match this function to
+tolerance; see tests/test_attention.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, *, q_offset: int = 0,
+                kv_offset: int = 0, dtype=jnp.float32) -> jax.Array:
+    """[q_len, kv_len] additive mask; offsets position the blocks within the
+    global sequence (used by blockwise/ring variants)."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = kv_offset + jnp.arange(kv_len)[None, :]
+    return jnp.where(q_pos >= kv_pos, 0.0, -jnp.inf).astype(dtype)
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """softmax(q·kᵀ/√d [+mask])·v over [B, S, H, D] tensors."""
+    head_dim = q.shape[-1]
+    scale = (head_dim**-0.5) if scale is None else scale
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        logits = logits + causal_mask(q.shape[1], k.shape[1])[None, None]
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

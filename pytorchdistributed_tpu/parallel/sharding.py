@@ -56,9 +56,24 @@ def fsdp_param_shardings(params, mesh: Mesh, *, min_weight_size: int = 2**14):
 
 def shardings_for_strategy(strategy: str, params, mesh: Mesh):
     """Map a named strategy (the reference's wrapper-class choice) onto
-    PartitionSpecs for the same single train step."""
+    NamedShardings for the same single train step.
+
+    ``params`` may be a boxed tree (leaves are `nn.Partitioned` carrying
+    logical axis names — the model zoo) or a plain tree (toy models). Boxed
+    trees go through the logical rule tables in parallel/tp.py, which is how
+    TP/2D strategies exist; plain trees use shape heuristics (dp/fsdp only).
+    """
+    from pytorchdistributed_tpu.parallel import tp
+
+    tp.logical_rules(strategy)  # validates the name against the one registry
+    if tp.has_logical_annotations(params):
+        return tp.logical_shardings(params, mesh, strategy)
     if strategy in ("dp", "ddp"):
         return replicated_shardings(params, mesh)
     if strategy in ("fsdp", "zero3"):
         return fsdp_param_shardings(params, mesh)
-    raise ValueError(f"unknown strategy {strategy!r}; use 'dp' or 'fsdp'")
+    raise ValueError(
+        f"strategy {strategy!r} needs a model with logical axis "
+        "annotations (nn.with_logical_partitioning); this param tree "
+        "has none"
+    )

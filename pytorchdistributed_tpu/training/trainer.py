@@ -22,6 +22,7 @@ import time
 from functools import partial
 from typing import Any, Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import optax
@@ -71,6 +72,8 @@ class Trainer:
         self.precision = precision or Policy.full()
         self.remat = remat
         self.log_every = log_every
+        from pytorchdistributed_tpu.parallel.tp import logical_rules
+        self._rules = logical_rules(strategy)
         self.logger = MetricLogger()
         self._loss_fn = loss_fn
         self.state: TrainState | None = None
@@ -85,7 +88,9 @@ class Trainer:
         materializing unsharded params on one device."""
 
         def make_state(rng, batch):
-            params = self.model.init(rng, *self._model_args(batch))
+            with nn.logical_axis_rules(self._rules):
+                variables = self.model.init(rng, *self._model_args(batch))
+            params = nn.meta.unbox(variables)
             opt_state = self.optimizer.init(params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32), params=params,
@@ -93,9 +98,22 @@ class Trainer:
             )
 
         rng = jax.random.key(seed)
-        abstract = jax.eval_shape(make_state, rng, sample_batch)
+        # Boxed abstract init: the Partitioned leaves carry the logical axis
+        # names the sharding rules consume. The full abstract state is
+        # derived from it (unbox + abstract optimizer init) rather than
+        # re-tracing the model.
+        abstract_boxed = jax.eval_shape(
+            lambda r, b: self.model.init(r, *self._model_args(b)),
+            rng, sample_batch,
+        )
+        abstract_params = nn.meta.unbox(abstract_boxed)
+        abstract = TrainState(
+            step=jax.ShapeDtypeStruct((), jnp.int32),
+            params=abstract_params,
+            opt_state=jax.eval_shape(self.optimizer.init, abstract_params),
+        )
         param_sh = shardings_for_strategy(
-            self.strategy, abstract.params, self.mesh
+            self.strategy, abstract_boxed, self.mesh
         )
         self.state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()),
@@ -134,7 +152,8 @@ class Trainer:
             def compute_loss(params):
                 cparams = policy.cast_params_for_compute(params)
                 cbatch = policy.cast_batch(batch)
-                loss, metrics = loss_fn(self.model, cparams, cbatch, rng)
+                with nn.logical_axis_rules(self._rules):
+                    loss, metrics = loss_fn(self.model, cparams, cbatch, rng)
                 return loss.astype(jnp.float32), metrics
 
             (_, metrics), grads = jax.value_and_grad(
@@ -165,7 +184,8 @@ class Trainer:
         """One optimizer step (the reference's ``_run_batch``)."""
         if self.state is None:
             self.init(batch)
-        self.state, metrics = self._step_fn(self.state, batch)
+        with jax.set_mesh(self.mesh):
+            self.state, metrics = self._step_fn(self.state, batch)
         return metrics
 
     # -- epochs ------------------------------------------------------------
