@@ -68,13 +68,18 @@ class DataLoader:
 
 
 def shard_batch(batch: dict[str, np.ndarray], sharding) -> dict[str, jax.Array]:
-    """Assemble the global device-laid-out batch from this process's shard."""
+    """Assemble the global device-laid-out batch from this process's shard.
+
+    ``sharding`` may be one NamedSharding for every leaf, or a callable
+    ``leaf -> NamedSharding`` (rank-aware per-leaf layout,
+    mesh.batch_leaf_sharding)."""
+    pick = sharding if callable(sharding) else (lambda _: sharding)
     if jax.process_count() > 1:
         return {
-            k: jax.make_array_from_process_local_data(sharding, v)
+            k: jax.make_array_from_process_local_data(pick(v), v)
             for k, v in batch.items()
         }
-    return jax.device_put(batch, sharding)
+    return {k: jax.device_put(v, pick(v)) for k, v in batch.items()}
 
 
 def prefetch_to_device(

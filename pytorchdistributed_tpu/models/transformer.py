@@ -101,13 +101,20 @@ def _dense_general(features: int, kernel_axes, cfg, name, *,
 
 
 class SelfAttention(nn.Module):
-    """Multi-head self-attention with Megatron-ready head sharding."""
+    """Multi-head self-attention with Megatron-ready head sharding.
+
+    ``deterministic`` is a module attribute (not a call arg) so lifted
+    transforms (nn.remat / nn.scan) see a plain (x,) call signature —
+    jax.checkpoint cannot mark keyword-only args static.
+    """
 
     cfg: TransformerConfig
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x):
         cfg = self.cfg
+        deterministic = self.deterministic
         b, s, _ = x.shape
         qkv = functools.partial(
             _dense_general, cfg.num_heads * cfg.head_dim,
@@ -139,10 +146,12 @@ class MlpBlock(nn.Module):
     rules XLA emits exactly Megatron's f/g psum pattern (parallel/tp.py)."""
 
     cfg: TransformerConfig
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x):
         cfg = self.cfg
+        deterministic = self.deterministic
         h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
                            "wi")(x)
         h = nn.with_logical_constraint(
@@ -171,16 +180,17 @@ class TransformerBlock(nn.Module):
     """Pre-LN block: x + Attn(LN(x)); x + MLP(LN(x))."""
 
     cfg: TransformerConfig
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x):
         cfg = self.cfg
         x = nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
         h = _layer_norm(cfg, "ln1")(x).astype(cfg.dtype)
-        x = x + SelfAttention(cfg, name="attn")(h, deterministic=deterministic)
+        x = x + SelfAttention(cfg, self.deterministic, name="attn")(h)
         h = _layer_norm(cfg, "ln2")(x).astype(cfg.dtype)
-        x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
+        x = x + MlpBlock(cfg, self.deterministic, name="mlp")(h)
         return nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 
@@ -197,23 +207,20 @@ class TransformerStack(nn.Module):
         cfg = self.cfg
         block = TransformerBlock
         if cfg.remat:
-            block = nn.remat(
-                block, prevent_cse=not cfg.scan_layers,
-                static_argnums=(2,),  # deterministic flag
-            )
+            # recompute block activations in backward (GPipe's "time for
+            # space", reference 03_model_parallel.ipynb:637-643)
+            block = nn.remat(block, prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic=deterministic),
-                                       None),
+                lambda mdl, carry, _: (mdl(carry), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: Logical.STAGE},
-            )(block(cfg, name="block"), x, None)
+            )(block(cfg, deterministic, name="block"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"block_{i}")(
-                    x, deterministic=deterministic)
+                x = block(cfg, deterministic, name=f"block_{i}")(x)
         return x
 
 

@@ -1,0 +1,201 @@
+"""Flash attention as a Pallas TPU kernel (SURVEY.md §5: "blockwise /
+Flash-style Pallas attention kernel").
+
+Forward: one fused kernel, grid (batch·heads, q_blocks, k_blocks). The
+online-softmax accumulator (m, l, acc) lives in VMEM scratch and is carried
+across the sequentially-executed k_blocks grid dimension; HBM traffic is one
+read of each Q/K/V block and one write of each O block — the flash
+recurrence. Causal blocks strictly above the diagonal are masked (their
+contribution is exactly zero).
+
+Backward: `jax.custom_vjp` whose bwd recomputes attention blockwise in plain
+JAX (a `lax.scan` flash recurrence XLA fuses well) and differentiates that —
+activation-recompute semantics (no S×S residuals stored), numerically
+identical gradients.
+
+On non-TPU backends (the CPU test sim) the kernel runs in Pallas interpret
+mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                block_q: int, block_k: int, causal: bool, scale: float,
+                num_k_blocks: int, seq_len: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    run = True
+    if causal:
+        # skip blocks strictly above the diagonal
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        # mask the padded K tail (seq_len not divisible by block_k) and,
+        # for causal, positions above the diagonal
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        valid = k_pos < seq_len
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+            valid = valid & (q_pos >= k_pos)
+        logits = jnp.where(valid, logits, _NEG_INF)
+        m_prev = m_ref[...]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, blk_max)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)  # [bq, bk]
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, -1, keepdims=True)
+        m_ref[...] = m_new
+        v = v_ref[0].astype(jnp.float32)                   # [bk, d]
+        # zero the padded V tail: p is 0 there, but 0·garbage(NaN) = NaN
+        v_pos = k_start + lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        v = jnp.where(v_pos < seq_len, v, 0.0)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
+               block_k: int, interpret: bool):
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq, nk = pl.cdiv(s, block_q), pl.cdiv(s, block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, num_k_blocks=nk, seq_len=s)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            _vmem_scratch((block_q, d)),
+            _vmem_scratch((block_q, 1)),
+            _vmem_scratch((block_q, 1)),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _vmem_scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _blockwise_reference(q, k, v, *, causal: bool, scale: float,
+                         block_k: int = 512):
+    """Flash recurrence in plain JAX ([bh, s, d] layout) — the recompute
+    target the custom bwd differentiates; O(s·block_k) memory via lax.scan."""
+    bh, s, d = q.shape
+    block_k = min(block_k, s)
+    nk = s // block_k if s % block_k == 0 else -(-s // block_k)
+    pad = nk * block_k - s
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(s)
+
+    def step(carry, i):
+        o, m, l = carry
+        k_blk = lax.dynamic_slice_in_dim(kp, i * block_k, block_k, 1)
+        v_blk = lax.dynamic_slice_in_dim(vp, i * block_k, block_k, 1)
+        logits = jnp.einsum("bqd,bkd->bqk", q32, k_blk.astype(jnp.float32))
+        k_pos = i * block_k + jnp.arange(block_k)
+        valid = k_pos < s
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (s, block_k))
+        logits = jnp.where(valid[None], logits, _NEG_INF)
+        blk_max = jnp.max(logits, -1)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.where(valid[None], jnp.exp(logits - m_new[..., None]), 0.0)
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bqk,bkd->bqd", p, v_blk.astype(jnp.float32))
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((bh, s, d), jnp.float32)
+    m0 = jnp.full((bh, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, s), jnp.float32)
+    (o, m, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(nk))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_reference(q, k, v, causal=causal,
+                                             scale=scale, block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: float | None = None, block_q: int = 512,
+                    block_k: int = 512, interpret: bool | None = None):
+    """[B, S, H, D] fused flash attention; drop-in for dense_attention."""
+    b, s, h, d = q.shape
+    scale = (d**-0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def fold(t):  # [B,S,H,D] -> [B*H, S, D]
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash(fold(q), fold(k), fold(v), causal, scale, block_q, block_k,
+                 interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,102 @@
+"""Ring attention — context parallelism over the "seq" mesh axis.
+
+Required for framework completeness (SURVEY.md §5 "Long-context": the only
+"ring" in the reference is ring-allreduce of *gradients*,
+02_ddp.ipynb:33-47 — ring attention is the missing long-context analog).
+
+Mechanism: Q stays put; K/V shards rotate around the ring one hop per step
+(`lax.ppermute`, which XLA lowers to neighbor ICI transfers on the TPU
+torus). Each device folds the visiting K/V block into a numerically-stable
+online-softmax accumulator (the FlashAttention recurrence), so the full
+[S, S] score matrix never materializes and per-device memory is
+O(S_local · S_block). Communication of step i+1 overlaps compute of step i
+because XLA schedules the ppermute DMA asynchronously.
+
+Gradients come for free: the loop is a `lax.scan`, so reverse-mode AD
+produces the reverse ring automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() exact zero without
+                  # generating NaNs in (m - new_m) when a row is all-masked
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float | None = None):
+    """Per-shard body: q,k,v are the local [B, S_local, H_local, D] blocks;
+    runs inside shard_map with ``axis_name`` bound."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = (d**-0.5) if scale is None else scale
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = my * s + jnp.arange(s)
+
+    def step(carry, i):
+        o, m, l, kv = carry
+        k_blk, v_blk = kv
+        src = (my - i) % n  # block id we hold after i forward rotations
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            kv_pos = src * s + jnp.arange(s)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        blk_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        new_o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V one hop around the ring (ICI neighbor transfer)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return (new_o, new_m, new_l, kv), None
+
+    # Mark the accumulators device-varying (jax 0.9 vma typing): inside
+    # shard_map a fresh zeros array is "invariant" while the scan writes
+    # varying values into it — pcast aligns the carry types.
+    vma = (Axis.DATA, Axis.FSDP, Axis.SEQ, Axis.TENSOR)
+    o0 = lax.pcast(jnp.zeros((b, s, h, d), jnp.float32), vma, to="varying")
+    m0 = lax.pcast(jnp.full((b, h, s), _NEG_INF, jnp.float32), vma,
+                   to="varying")
+    l0 = lax.pcast(jnp.zeros((b, h, s), jnp.float32), vma, to="varying")
+    (o, m, l, _), _ = lax.scan(step, (o0, m0, l0, (k, v)), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, *, causal: bool = False,
+                           mesh=None, scale: float | None = None):
+    """Drop-in replacement for ops.attention.dense_attention on inputs whose
+    seq dim is sharded over the "seq" mesh axis (and heads optionally over
+    "tensor"). Uses the ambient mesh (`jax.set_mesh`) unless given one.
+    """
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.axis_names:
+            raise ValueError(
+                "ring attention needs a mesh: call under jax.set_mesh(mesh) "
+                "or pass mesh=")
+    spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=Axis.SEQ,
+                          causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,61 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head↔sequence
+redistribution (SURVEY.md §5 "Ulysses-style all-to-all head redistribution as
+the alternative when heads ≥ shards").
+
+Inputs arrive sequence-sharded ([B, S/n, H, D] per device). One
+`lax.all_to_all` re-shards them head-wise ([B, S, H/n, D]) so each device
+runs *dense* attention over the full sequence for its head subset; a second
+all-to-all restores sequence sharding. Two all-to-alls per attention call vs
+ring's n ppermutes — cheaper when the head count divides evenly and the
+sequence fits per-device memory.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorchdistributed_tpu.ops.attention import dense_attention
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   scale: float | None):
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"Ulysses needs heads ({q.shape[2]}) divisible by the seq axis "
+            f"size ({n}); use ring attention otherwise")
+    # [B, S/n, H, D] -> [B, S, H/n, D]: split heads, gather sequence.
+    to_heads = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    out = dense_attention(q, k, v, causal=causal, scale=scale)
+    # [B, S, H/n, D] -> [B, S/n, H, D]
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
+                      scale: float | None = None):
+    """Sequence-parallel attention via head redistribution; same calling
+    convention as ring_attention_sharded."""
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.axis_names:
+            raise ValueError(
+                "ulysses attention needs a mesh: call under "
+                "jax.set_mesh(mesh) or pass mesh=")
+    spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=Axis.SEQ, causal=causal,
+                          scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

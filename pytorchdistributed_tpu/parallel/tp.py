@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import flax.linen as nn
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from pytorchdistributed_tpu.runtime.mesh import Axis
 
@@ -115,21 +115,3 @@ def logical_shardings(abstract_params, mesh: Mesh, strategy: str):
     return nn.logical_to_mesh_sharding(specs, mesh, logical_rules(strategy))
 
 
-def tensor_parallel_size(mesh: Mesh) -> int:
-    return mesh.shape[Axis.TENSOR]
-
-
-def column_parallel(features_axis: str = Logical.MLP):
-    """Partitioning metadata for a column-parallel Dense kernel
-    (embed → sharded features; Megatron's `f` side)."""
-    return (Logical.EMBED, features_axis)
-
-
-def row_parallel(features_axis: str = Logical.MLP):
-    """Row-parallel Dense kernel (sharded features → embed; XLA inserts the
-    activation psum that Megatron's `g` performs)."""
-    return (features_axis, Logical.EMBED)
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())

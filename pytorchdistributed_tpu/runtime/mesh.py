@@ -172,5 +172,13 @@ def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
     return NamedSharding(mesh, P(batch_axes))
 
 
+def batch_leaf_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Rank-aware batch sharding: leading dim over the data axes; 2-D
+    token-shaped leaves ([batch, seq] tokens/targets/masks) additionally
+    sharded over "seq" when the mesh has a context-parallel axis. Rank-1
+    leaves (labels) and rank-4 images never get a seq spec."""
+    return batch_sharding(mesh, seq_axis=(ndim == 2))
+
+
 def data_parallel_size(mesh: Mesh) -> int:
     return mesh.shape[Axis.DATA] * mesh.shape[Axis.FSDP]

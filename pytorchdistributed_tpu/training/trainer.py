@@ -33,7 +33,8 @@ from pytorchdistributed_tpu.data.loader import prefetch_to_device
 from pytorchdistributed_tpu.parallel.precision import Policy
 from pytorchdistributed_tpu.parallel.sharding import shardings_for_strategy
 from pytorchdistributed_tpu.runtime import dist
-from pytorchdistributed_tpu.runtime.mesh import batch_sharding, create_mesh
+from pytorchdistributed_tpu.data.loader import shard_batch
+from pytorchdistributed_tpu.runtime.mesh import batch_leaf_sharding, create_mesh
 from pytorchdistributed_tpu.training.logging import MetricLogger
 
 
@@ -79,7 +80,12 @@ class Trainer:
         self.state: TrainState | None = None
         self.state_shardings = None
         self._step_fn = None
-        self.batch_sharding = batch_sharding(self.mesh)
+        # Rank-aware per-leaf batch layout: leading dim over the data axes;
+        # 2-D token leaves also over "seq" when the mesh has a
+        # context-parallel axis (ring/ulysses attention read seq-sharded
+        # activations inside shard_map).
+        self.batch_sharding = lambda leaf: batch_leaf_sharding(
+            self.mesh, getattr(leaf, "ndim", 0))
 
     # -- initialization ----------------------------------------------------
 
@@ -101,11 +107,14 @@ class Trainer:
         # Boxed abstract init: the Partitioned leaves carry the logical axis
         # names the sharding rules consume. The full abstract state is
         # derived from it (unbox + abstract optimizer init) rather than
-        # re-tracing the model.
-        abstract_boxed = jax.eval_shape(
-            lambda r, b: self.model.init(r, *self._model_args(b)),
-            rng, sample_batch,
-        )
+        # re-tracing the model. Traced under the mesh context: sharded
+        # attention (ring/ulysses shard_map) needs the ambient mesh even
+        # abstractly.
+        with jax.set_mesh(self.mesh):
+            abstract_boxed = jax.eval_shape(
+                lambda r, b: self.model.init(r, *self._model_args(b)),
+                rng, sample_batch,
+            )
         abstract_params = nn.meta.unbox(abstract_boxed)
         abstract = TrainState(
             step=jax.ShapeDtypeStruct((), jnp.int32),
@@ -175,7 +184,7 @@ class Trainer:
 
         return jax.jit(
             step,
-            in_shardings=(self.state_shardings, self.batch_sharding),
+            in_shardings=(self.state_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
         )
@@ -184,6 +193,8 @@ class Trainer:
         """One optimizer step (the reference's ``_run_batch``)."""
         if self.state is None:
             self.init(batch)
+        if any(not isinstance(v, jax.Array) for v in batch.values()):
+            batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
             self.state, metrics = self._step_fn(self.state, batch)
         return metrics
@@ -226,17 +237,25 @@ class Trainer:
 
 def _opt_state_shardings(abstract_opt_state, abstract_params, param_shardings,
                          mesh):
-    """Optimizer slots that mirror a parameter (momentum, adam m/v) inherit
-    its sharding — that is ZeRO's optimizer-state partitioning. Anything
-    else (step counters) is replicated."""
-    flat_params, _ = jax.tree.flatten(abstract_params)
-    flat_shard, _ = jax.tree.flatten(param_shardings)
-    by_shape = {}
-    for p, s in zip(flat_params, flat_shard):
-        by_shape.setdefault((p.shape, p.dtype), s)
+    """Optimizer slots that mirror the parameter pytree (momentum, adam m/v)
+    inherit the parameter shardings leaf-for-leaf — ZeRO's optimizer-state
+    partitioning. Matching is *structural* (same treedef and leaf shapes),
+    never by shape lookup: same-shaped params can carry different shardings
+    under TP. Anything else (step counters, schedules) is replicated."""
+    target = jax.tree.structure(abstract_params)
+    param_shapes = [p.shape for p in jax.tree.leaves(abstract_params)]
 
-    def pick(leaf):
-        key = (leaf.shape, getattr(leaf, "dtype", None))
-        return by_shape.get(key, NamedSharding(mesh, P()))
+    def mirrors_params(node):
+        try:
+            if jax.tree.structure(node) != target:
+                return False
+            return [l.shape for l in jax.tree.leaves(node)] == param_shapes
+        except Exception:
+            return False
 
-    return jax.tree.map(pick, abstract_opt_state)
+    def pick(node):
+        if mirrors_params(node):
+            return param_shardings
+        return jax.tree.map(lambda _: NamedSharding(mesh, P()), node)
+
+    return jax.tree.map(pick, abstract_opt_state, is_leaf=mirrors_params)

@@ -137,3 +137,27 @@ def test_scan_vs_unrolled_same_shape():
         params = model.init(jax.random.key(0), batch["tokens"])
         outs[scan] = model.apply(params, batch["tokens"])
     assert outs[True].shape == outs[False].shape
+
+
+def test_remat_trains_and_matches():
+    """remat=True (activation checkpointing) must not change the math."""
+    rng = np.random.default_rng(2)
+    batch = _token_batch(rng)
+    losses = {}
+    for remat in (False, True):
+        model = GPT2(gpt2_config("test", remat=remat, dtype=np.float32))
+        tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(), strategy="dp")
+        losses[remat] = [float(tr.train_step(batch)["loss"]) for _ in range(2)]
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+
+def test_rank1_batch_leaves_with_seq_mesh():
+    """Rank-aware batch shardings: labels (rank 1) and images (rank 4) must
+    survive a mesh that has a context-parallel axis."""
+    rng = np.random.default_rng(0)
+    model = resnet18(num_classes=10, cifar_stem=True)
+    tr = Trainer(model, optax.sgd(0.05), cross_entropy_loss,
+                 mesh=create_mesh(data=4, seq=2), strategy="dp")
+    m = tr.train_step(_image_batch(rng))
+    assert np.isfinite(float(m["loss"]))
