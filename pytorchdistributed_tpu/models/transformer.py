@@ -52,6 +52,10 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = False
     tie_embeddings: bool = True
+    # Pipeline parallelism (parallel/pipeline.py): >1 runs the stack as a
+    # GPipe pipeline over the "pipe" mesh axis with this many stages.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -205,6 +209,8 @@ class TransformerStack(nn.Module):
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         cfg = self.cfg
+        if cfg.pipeline_stages > 1 and not self.is_initializing():
+            return self._pipelined(x, deterministic)
         block = TransformerBlock
         if cfg.remat:
             # recompute block activations in backward (GPipe's "time for
@@ -222,6 +228,43 @@ class TransformerStack(nn.Module):
             for i in range(cfg.num_layers):
                 x = block(cfg, deterministic, name=f"block_{i}")(x)
         return x
+
+    def _pipelined(self, x, deterministic: bool):
+        """Apply-path GPipe: reuse the layer-stacked params the init-path
+        nn.scan created ([L, ...] leaves, logical axis "stage" → mesh axis
+        "pipe") and drive them with the shard_map pipeline schedule
+        (parallel/pipeline.py) instead of the sequential scan."""
+        from pytorchdistributed_tpu.parallel.pipeline import gpipe_spmd
+
+        cfg = self.cfg
+        p = cfg.pipeline_stages
+        if not cfg.scan_layers:
+            raise ValueError("pipeline_stages > 1 requires scan_layers=True")
+        if cfg.num_layers % p != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"pipeline_stages {p}")
+        if cfg.dropout_rate > 0 and not deterministic:
+            raise NotImplementedError(
+                "dropout inside the pipelined stack is not supported yet")
+        stacked = self.get_variable("params", "block")
+        # [L, ...] -> [P, L/P, ...]: contiguous layer groups become stages,
+        # matching the existing stage-axis sharding layout.
+        stage_params = jax.tree.map(
+            lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
+            stacked)
+        block_mod = TransformerBlock(cfg, deterministic)
+
+        def stage_apply(params, h):
+            def layer(h, layer_params):
+                return block_mod.apply({"params": layer_params}, h), None
+
+            h, _ = jax.lax.scan(layer, h, params)
+            return h
+
+        return gpipe_spmd(stage_apply, stage_params, x,
+                          num_microbatches=cfg.pipeline_microbatches,
+                          remat=cfg.remat)
 
 
 class Embedder(nn.Module):

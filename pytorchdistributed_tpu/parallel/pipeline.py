@@ -1,0 +1,134 @@
+"""Pipeline parallelism — GPipe schedule, SPMD-style (SURVEY.md §2c "PP").
+
+The reference implements a manual 2-stage pipeline: split the batch into
+micro-batches and overlap stage-2 of split k with stage-1 of split k+1
+(reference 03_model_parallel.ipynb:538-560), with GPipe/1F1B schedule theory
+in cells 14-15 (:637-710). On TPU the idiomatic equivalent is *not* device
+placement + streams but a shard_map over the "pipe" mesh axis:
+
+  * every device holds its stage's parameters (the scanned layer axis is
+    sharded over "pipe" — parallel/tp.py rule STAGE→pipe);
+  * one `lax.scan` runs T = M + P - 1 ticks; at each tick every device
+    applies its stage to the activation it holds, then `ppermute` rotates
+    activations one hop to the next stage (neighbor ICI transfer);
+  * stage 0 injects micro-batch t at tick t, stage P-1 banks its result at
+    tick t into the output buffer — the software pipeline the reference
+    builds by hand with CUDA streams, expressed as one compiled collective
+    loop;
+  * backward is automatic: reverse-mode AD of scan+ppermute runs the
+    mirror-image reverse pipeline (activations for each micro-batch are
+    rematerialized per-stage when ``remat=True`` — GPipe's activation
+    recomputation, reference :637-643).
+
+Only the "pipe" axis goes manual (`axis_names={"pipe"}`): data/fsdp/tensor/
+seq stay under the automatic partitioner, so PP composes with every other
+strategy — inside a stage, XLA still inserts the TP psums and FSDP
+all-gathers.
+
+Bubble fraction is (P-1)/(M+P-1), the GPipe figure; the micro-batch count M
+is the knob the reference sweeps in its split-size benchmark (:586-623).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+
+def gpipe_spmd(
+    stage_apply: Callable,
+    stage_params,
+    x: jax.Array,
+    *,
+    num_microbatches: int,
+    mesh=None,
+    remat: bool = True,
+):
+    """Run ``stage_apply(params_for_my_stage, h) -> h`` as a GPipe pipeline
+    over the "pipe" mesh axis.
+
+    ``stage_params``: pytree whose leaves have leading dim P (stage-stacked,
+    sharded over "pipe"). ``x``: [batch, ...] global activations (any
+    data/seq sharding — those axes stay automatic). ``num_microbatches``
+    must divide the global batch. Returns activations with x's layout.
+    """
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.axis_names:
+            raise ValueError(
+                "gpipe_spmd needs a mesh: call under jax.set_mesh(mesh) or "
+                "pass mesh=")
+    if Axis.PIPE not in mesh.axis_names:
+        raise ValueError(
+            f"gpipe_spmd needs a '{Axis.PIPE}' mesh axis; got axes "
+            f"{mesh.axis_names} (build the mesh with runtime.mesh.create_mesh)")
+    n_stages = mesh.shape[Axis.PIPE]
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading dims {leading} must equal the mesh's "
+            f"pipe axis size {n_stages}")
+    if remat:
+        stage_apply = jax.checkpoint(stage_apply)
+
+    param_spec = jax.tree.map(lambda _: P(Axis.PIPE), stage_params)
+
+    fn = jax.shard_map(
+        functools.partial(_gpipe_local, stage_apply,
+                          num_microbatches=num_microbatches,
+                          n_stages=n_stages),
+        mesh=mesh,
+        axis_names={Axis.PIPE},
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+    )
+    return fn(stage_params, x)
+
+
+def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
+                 n_stages: int):
+    """Per-device pipeline body (inside shard_map, "pipe" axis manual)."""
+    m = num_microbatches
+    p = n_stages
+    stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
+    my_stage = lax.axis_index(Axis.PIPE)
+
+    b = x.shape[0]
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches {m}")
+    mb = b // m
+    x_mb = x.reshape(m, mb, *x.shape[1:])
+
+    acts0 = lax.pcast(jnp.zeros_like(x_mb[0]), Axis.PIPE, to="varying")
+    outs0 = lax.pcast(jnp.zeros_like(x_mb), Axis.PIPE, to="varying")
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def tick(carry, t):
+        acts, outs = carry
+        # stage 0 feeds micro-batch t; everyone else consumes the rotated
+        # activation from the previous stage
+        feed = x_mb[jnp.clip(t, 0, m - 1)]
+        h_in = jnp.where(my_stage == 0, feed, acts)
+        h_out = stage_apply(stage_params, h_in)
+        # last stage banks micro-batch t-(p-1) at tick t
+        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        banked = lax.dynamic_update_index_in_dim(outs, h_out, out_idx, 0)
+        write = (my_stage == p - 1) & (t >= p - 1)
+        outs = jnp.where(write, banked, outs)
+        acts = lax.ppermute(h_out, Axis.PIPE, perm)
+        return (acts, outs), None
+
+    (_, outs), _ = lax.scan(tick, (acts0, outs0), jnp.arange(m + p - 1))
+    # only stage p-1 holds real outputs; psum over "pipe" replicates them
+    # (and marks the result invariant over the axis for the out_spec)
+    outs = lax.psum(
+        jnp.where(my_stage == p - 1, outs, jnp.zeros_like(outs)), Axis.PIPE)
+    return outs.reshape(b, *outs.shape[2:])
