@@ -1,0 +1,108 @@
+"""torchrun-style launcher CLI with elastic restart.
+
+    python -m pytorchdistributed_tpu.run --nproc-per-node 2 train.py --lr 3e-4
+
+The agent process (this module) spawns one worker per rank with the env
+contract the reference's scripts read (RANK / WORLD_SIZE / LOCAL_RANK /
+MASTER_ADDR / MASTER_PORT — reference ddp_gpus_torchrun.py:14-19), watches
+for failures, and on ``--max-restarts > 0`` tears the group down and
+relaunches it — restart-from-checkpoint semantics (workers are expected to
+resume via Trainer.fit(resume=True); SURVEY.md §5 "Failure detection /
+elastic recovery").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_group(argv, nproc: int, port: int,
+                 devices_per_proc: int | None) -> list[subprocess.Popen]:
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "RANK": str(rank),
+            "LOCAL_RANK": str(rank),
+            "WORLD_SIZE": str(nproc),
+            "MASTER_ADDR": "localhost",
+            "MASTER_PORT": str(port),
+        })
+        if devices_per_proc is not None:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"{env.get('XLA_FLAGS', '')} "
+                f"--xla_force_host_platform_device_count={devices_per_proc}"
+            ).strip()
+        procs.append(subprocess.Popen([sys.executable] + argv, env=env))
+    return procs
+
+
+def _kill_group(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "pytorchdistributed_tpu.run",
+        description="torchrun-equivalent launcher "
+                    "(reference ddp_gpus_torchrun.py:102)")
+    parser.add_argument("--nproc-per-node", type=int, default=1)
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="relaunch the whole group this many times if a "
+                             "rank fails (workers resume from checkpoints)")
+    parser.add_argument("--monitor-interval", type=float, default=0.2)
+    parser.add_argument("--devices-per-proc", type=int, default=None,
+                        help="CPU-sim chips per process (sets JAX_PLATFORMS="
+                             "cpu + xla_force_host_platform_device_count)")
+    parser.add_argument("script", help="training script to run")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    worker_argv = [args.script] + args.script_args
+    restarts = 0
+    while True:
+        port = _free_port()
+        procs = _spawn_group(worker_argv, args.nproc_per_node, port,
+                             args.devices_per_proc)
+        failed_rank = None
+        while failed_rank is None:
+            time.sleep(args.monitor_interval)
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                failed_rank = codes.index(
+                    next(c for c in codes if c not in (None, 0)))
+            elif all(c == 0 for c in codes):
+                return 0
+        _kill_group(procs)
+        if restarts >= args.max_restarts:
+            print(f"[run] rank {failed_rank} failed; no restarts left",
+                  file=sys.stderr)
+            return 1
+        restarts += 1
+        print(f"[run] rank {failed_rank} failed; restart "
+              f"{restarts}/{args.max_restarts}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

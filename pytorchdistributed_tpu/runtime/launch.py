@@ -1,0 +1,101 @@
+"""Process launching — the framework's L2 (SURVEY.md §1).
+
+Two entry styles, mirroring the reference's lesson pair:
+
+  * ``launch(fn, nprocs)`` — the `mp.spawn` style (reference ddp_gpus.py:98):
+    parent spawns one process per "device group", passing the rank as the
+    first argument;
+  * ``python -m pytorchdistributed_tpu.run --nproc-per-node N script.py``
+    — the torchrun style (reference ddp_gpus_torchrun.py:102): an agent
+    process sets the env contract (RANK / WORLD_SIZE / LOCAL_RANK /
+    MASTER_ADDR / MASTER_PORT) and the script reads it via
+    runtime.dist.init_process_group. Implemented in runtime/run.py with
+    elastic restart (SURVEY.md §5 "Failure detection").
+
+On a real TPU pod there is one process per host and the TPU runtime itself
+provides topology metadata, so these launchers matter for (a) CPU-sim
+multi-process testing — the analog of BASELINE's "gloo CPU smoke" — and
+(b) driving jax.distributed rendezvous when infra (GKE/QueuedResources)
+doesn't.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+from typing import Callable, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(rank: int, world_size: int, port: int,
+                devices_per_proc: int | None) -> dict[str, str]:
+    env = {
+        "RANK": str(rank),
+        "LOCAL_RANK": str(rank),
+        "WORLD_SIZE": str(world_size),
+        "MASTER_ADDR": "localhost",
+        "MASTER_PORT": str(port),
+    }
+    if devices_per_proc is not None:
+        # CPU-sim: each process gets its own simulated chips
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{devices_per_proc}").strip()
+    return env
+
+
+def _worker(fn: Callable, rank: int, world_size: int, port: int,
+            devices_per_proc: int | None, args: tuple) -> None:
+    os.environ.update(_worker_env(rank, world_size, port, devices_per_proc))
+    fn(rank, *args)
+
+
+def launch(
+    fn: Callable,
+    nprocs: int,
+    *,
+    args: Sequence = (),
+    devices_per_proc: int | None = None,
+    timeout: float | None = None,
+) -> None:
+    """Spawn ``nprocs`` processes running ``fn(rank, *args)`` with the
+    rendezvous env set (the reference's ``mp.spawn(main, args=...,
+    nprocs=world_size)``, ddp_gpus.py:98). Raises RuntimeError if any child
+    exits nonzero — after terminating the rest (fail-fast, the behavior
+    torchrun's agent provides)."""
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    procs = [
+        ctx.Process(
+            target=_worker,
+            args=(fn, rank, nprocs, port, devices_per_proc, tuple(args)),
+            name=f"tpu-dist-rank{rank}",
+        )
+        for rank in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    failed = None
+    try:
+        for rank, p in enumerate(procs):
+            p.join(timeout)
+            if p.exitcode is None:
+                failed = failed or (rank, "timeout")
+            elif p.exitcode != 0:
+                failed = failed or (rank, f"exit code {p.exitcode}")
+    finally:
+        if failed:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+    if failed:
+        raise RuntimeError(
+            f"rank {failed[0]} failed ({failed[1]}); terminated the rest")

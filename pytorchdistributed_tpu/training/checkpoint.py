@@ -1,0 +1,82 @@
+"""Sharded checkpoint / resume (SURVEY.md §5: the reference has no
+`state_dict`/save/load at all; BASELINE's GPT-2 FSDP config requires it).
+
+Built on orbax: each host writes only the param shards it owns (no gather
+to host 0 — the torch `state_dict` anti-pattern at pod scale), saves run
+async so the train loop isn't blocked, and restore takes abstract
+shardings so a checkpoint written on one mesh can resume on another
+(re-sharding happens inside orbax/XLA on load).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """``save(step, state)`` / ``restore(abstract_state)`` / ``latest_step()``.
+
+    ``abstract_state``: a pytree of jax.ShapeDtypeStruct with shardings (the
+    Trainer passes its state_shardings applied to the current abstract
+    state), so restore places every shard directly on its owning device —
+    including onto a *different* mesh than the one that saved.
+    """
+
+    def __init__(self, directory: str | pathlib.Path, *,
+                 max_to_keep: int | None = 3, save_interval_steps: int = 1):
+        self.directory = pathlib.Path(directory).absolute()
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async sharded save; returns whether a save was started."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, abstract_state: Any, *, step: int | None = None) -> Any:
+        """Restore ``step`` (default: latest) onto the shardings carried by
+        ``abstract_state``."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable (call before exit
+        and in tests)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()
+
+
+def abstract_state_like(state, state_shardings):
+    """ShapeDtypeStruct tree carrying the target shardings, for restore."""
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        state, state_shardings)

@@ -65,6 +65,8 @@ class Trainer:
         precision: Policy | None = None,
         remat: bool = False,
         log_every: int = 10,
+        checkpoint_dir: str | None = None,
+        checkpoint_every_steps: int = 0,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -75,6 +77,15 @@ class Trainer:
         self.log_every = log_every
         from pytorchdistributed_tpu.parallel.tp import logical_rules
         self._rules = logical_rules(strategy)
+        self.checkpoint = None
+        self._checkpoint_every = checkpoint_every_steps
+        if checkpoint_dir is not None:
+            from pytorchdistributed_tpu.training.checkpoint import (
+                CheckpointManager,
+            )
+            self.checkpoint = CheckpointManager(
+                checkpoint_dir,
+                save_interval_steps=max(checkpoint_every_steps, 1))
         self.logger = MetricLogger()
         self._loss_fn = loss_fn
         self.state: TrainState | None = None
@@ -201,9 +212,11 @@ class Trainer:
 
     # -- epochs ------------------------------------------------------------
 
-    def run_epoch(self, loader, epoch: int) -> dict[str, float]:
+    def run_epoch(self, loader, epoch: int, *,
+                  skip_steps: int = 0) -> dict[str, float]:
         """The reference's ``_run_epoch`` (ddp_gpus.py:44-51), without its
-        extra-batch-fetch wart (SURVEY.md §3.1)."""
+        extra-batch-fetch wart (SURVEY.md §3.1). ``skip_steps`` fast-forwards
+        past batches a resumed mid-epoch checkpoint already trained on."""
         loader.set_epoch(epoch)
         if dist.is_main_process():
             self.logger.info(
@@ -211,28 +224,78 @@ class Trainer:
                 f"per-process batch {loader.batch_size}"
             )
         metrics = {}
-        it = prefetch_to_device(iter(loader), self.batch_sharding)
-        for i, batch in enumerate(it):
+        raw = iter(loader)
+        for _ in range(skip_steps):  # already trained before the restart
+            next(raw, None)
+        it = prefetch_to_device(raw, self.batch_sharding)
+        for i, batch in enumerate(it, start=skip_steps):
             if self.state is None:
                 self.init(batch)
             metrics = self.train_step(batch)
             if (i + 1) % self.log_every == 0 and dist.is_main_process():
                 vals = {k: float(v) for k, v in metrics.items()}
                 self.logger.log_step(epoch, i + 1, vals)
+            if (self.checkpoint is not None and self._checkpoint_every > 0
+                    and (i + 1) % self._checkpoint_every == 0):
+                self._save_checkpoint()
         return {k: float(v) for k, v in metrics.items()}
 
-    def fit(self, loader, max_epochs: int) -> dict[str, float]:
-        """The reference's ``train`` (ddp_gpus.py:53-55)."""
+    def _save_checkpoint(self, *, force: bool = False) -> None:
+        """Save unless this step is already on disk (an epoch-end save can
+        land on the same step as the last interval save)."""
+        step = int(self.state.step)
+        if step in self.checkpoint.all_steps():
+            return
+        self.checkpoint.save(step, self.state, force=force)
+
+    def fit(self, loader, max_epochs: int, *,
+            resume: bool = False) -> dict[str, float]:
+        """The reference's ``train`` (ddp_gpus.py:53-55), plus
+        checkpoint/resume (SURVEY.md §5): with a checkpoint_dir configured,
+        every epoch end saves the sharded state async, and ``resume=True``
+        continues from the latest step."""
+        start_epoch, skip = 0, 0
+        if resume and self.checkpoint is not None \
+                and self.checkpoint.latest_step() is not None:
+            start_epoch, skip = self._resume(loader)
         metrics = {}
-        for epoch in range(max_epochs):
+        for epoch in range(start_epoch, max_epochs):
             t0 = time.perf_counter()
-            metrics = self.run_epoch(loader, epoch)
+            metrics = self.run_epoch(
+                loader, epoch, skip_steps=skip if epoch == start_epoch else 0)
+            if self.checkpoint is not None:
+                self._save_checkpoint(force=True)
             if dist.is_main_process():
                 self.logger.info(
                     f"epoch {epoch} done in {time.perf_counter() - t0:.2f}s "
                     f"| {metrics}"
                 )
+        if self.checkpoint is not None:
+            self.checkpoint.wait()
         return metrics
+
+    def _resume(self, loader) -> tuple[int, int]:
+        """Restore the latest checkpoint (re-sharding onto the current mesh
+        if it differs from the saving run's). Returns (epoch to resume at,
+        batches of that epoch to skip) — a mid-epoch checkpoint fast-forwards
+        past the already-trained prefix so no batch is trained twice."""
+        from pytorchdistributed_tpu.training.checkpoint import (
+            abstract_state_like,
+        )
+
+        if self.state is None:
+            loader.set_epoch(0)
+            self.init(next(iter(loader)))
+        self.state = self.checkpoint.restore(
+            abstract_state_like(self.state, self.state_shardings))
+        step = int(self.state.step)
+        steps_per_epoch = max(len(loader), 1)
+        start_epoch = step // steps_per_epoch
+        skip = step % steps_per_epoch
+        if dist.is_main_process():
+            self.logger.info(f"resumed from step {step} "
+                             f"(epoch {start_epoch}, skipping {skip})")
+        return start_epoch, skip
 
 
 def _opt_state_shardings(abstract_opt_state, abstract_params, param_shardings,

@@ -1,0 +1,14 @@
+from pytorchdistributed_tpu.utils.metrics import (  # noqa: F401
+    StepTimer,
+    ThroughputMeter,
+    scaling_efficiency,
+)
+from pytorchdistributed_tpu.utils.guards import (  # noqa: F401
+    NaNWatchdog,
+    assert_finite,
+    assert_replicas_consistent,
+)
+from pytorchdistributed_tpu.utils.profiling import (  # noqa: F401
+    profile,
+    step_annotation,
+)

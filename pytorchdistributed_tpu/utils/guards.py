@@ -1,0 +1,66 @@
+"""Runtime guards (SURVEY.md §5 "Race detection / sanitizers"): the
+reference leans entirely on NCCL's synchronous collective semantics; on TPU
+XLA's static schedule removes data races by construction, so the remaining
+failure classes are (a) divergent state across processes — which deadlocks
+collectives the way mismatched NCCL calls do — and (b) numeric blowups.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def assert_finite(tree, *, name: str = "tree") -> None:
+    """NaN/Inf watchdog: raises FloatingPointError naming every offending
+    leaf (path included — the debugging detail torch's detect_anomaly buries)."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {name}: {', '.join(bad)}")
+
+
+class NaNWatchdog:
+    """Periodic finite-check on metrics/state during training; cheap (only
+    metrics every step, full state every ``state_every`` checks)."""
+
+    def __init__(self, state_every: int = 100):
+        self.state_every = state_every
+        self._count = 0
+
+    def check(self, metrics: dict, state=None) -> None:
+        for k, v in metrics.items():
+            if not np.isfinite(float(v)):
+                raise FloatingPointError(f"metric {k!r} is {float(v)}")
+        self._count += 1
+        if state is not None and self._count % self.state_every == 0:
+            assert_finite(state.params, name="params")
+
+
+def assert_replicas_consistent(tree, *, name: str = "pytree") -> None:
+    """Cross-process collective-mismatch guard (SURVEY.md §5): every process
+    must hold an identical tree structure + leaf shapes/dtypes before
+    compiling a collective program, else the pod deadlocks mid-compile the
+    way mismatched NCCL calls do. Call before the first train step on
+    multi-process runs; no-op single-process."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree.flatten(tree)
+    desc = str(treedef) + "|" + "|".join(
+        f"{getattr(l, 'shape', ())}:{getattr(l, 'dtype', type(l).__name__)}"
+        for l in leaves)
+    digest = np.frombuffer(
+        __import__("hashlib").sha256(desc.encode()).digest()[:8],
+        dtype=np.int64)
+    gathered = multihost_utils.process_allgather(digest)
+    if not (gathered == gathered[0]).all():
+        raise RuntimeError(
+            f"{name} differs across processes (collective-mismatch guard): "
+            f"digests {gathered.ravel().tolist()}")

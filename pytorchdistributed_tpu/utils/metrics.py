@@ -1,0 +1,92 @@
+"""Step-time / throughput / scaling-efficiency meters (SURVEY.md §5:
+"per-step metrics (loss, step time, tokens/s or img/s, scaling efficiency)
+since those are the BASELINE metric").
+
+The reference's only measurement device is `timeit.repeat(number=1,
+repeat=10)` → mean±std (03_model_parallel.ipynb:403-423); `StepTimer.timeit`
+reproduces that exact methodology so our benchmark numbers are comparable
+with its harness shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Wall-clock per-step timer with warmup discard (first compile)."""
+
+    warmup: int = 1
+    _times: list = dataclasses.field(default_factory=list)
+    _seen: int = 0
+    _t0: float | None = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._seen += 1
+        if self._seen > self.warmup:
+            self._times.append(dt)
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self._times)) if self._times else float("nan")
+
+    @property
+    def std(self) -> float:
+        return float(np.std(self._times)) if self._times else float("nan")
+
+    @staticmethod
+    def timeit(fn: Callable[[], None], *, repeat: int = 10) -> tuple[float, float]:
+        """The reference's methodology: run ``fn`` ``repeat`` times, one
+        execution each, report mean±std (03_model_parallel.ipynb:403-423)."""
+        times = []
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return float(np.mean(times)), float(np.std(times))
+
+
+class ThroughputMeter:
+    """samples/s (or img/s, tokens/s) over a sliding window, excluding the
+    compile step."""
+
+    def __init__(self, window: int = 50, warmup: int = 1):
+        self.window = window
+        self.warmup = warmup
+        self._stamps: list[tuple[float, int]] = []
+        self._seen = 0
+
+    def update(self, n_samples: int) -> None:
+        self._seen += 1
+        if self._seen <= self.warmup:
+            return
+        self._stamps.append((time.perf_counter(), n_samples))
+        if len(self._stamps) > self.window:
+            self._stamps.pop(0)
+
+    @property
+    def rate(self) -> float:
+        if len(self._stamps) < 2:
+            return float("nan")
+        dt = self._stamps[-1][0] - self._stamps[0][0]
+        n = sum(s for _, s in self._stamps[1:])
+        return n / dt if dt > 0 else float("nan")
+
+
+def scaling_efficiency(throughput_n: float, throughput_1: float,
+                       n: int) -> float:
+    """DDP scaling efficiency (BASELINE north star: ≥0.90 at 8→256 chips):
+    throughput on n chips / (n × throughput on 1 chip)."""
+    if n <= 0 or throughput_1 <= 0:
+        return float("nan")
+    return throughput_n / (n * throughput_1)

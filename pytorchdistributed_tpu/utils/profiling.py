@@ -1,0 +1,30 @@
+"""Profiling hooks (SURVEY.md §5 "Tracing / profiling": the reference has
+only timeit+matplotlib; here: the jax profiler, viewable in
+TensorBoard/Perfetto/XProf).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(logdir: str, *, first_step: int = 0):
+    """Capture a device trace for the enclosed steps:
+
+        with profile("/tmp/trace"):
+            for _ in range(5): trainer.train_step(batch)
+
+    Open with TensorBoard's profile plugin or ui.perfetto.dev."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(name: str):
+    """Label a region so it shows up named in the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

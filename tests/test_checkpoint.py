@@ -1,0 +1,129 @@
+"""Checkpoint/resume tests (SURVEY.md §5: sharded save, async, resume with
+re-sharding)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.runtime.mesh import create_mesh
+from pytorchdistributed_tpu.training import Trainer, token_cross_entropy_loss
+from pytorchdistributed_tpu.training.checkpoint import (
+    CheckpointManager,
+    abstract_state_like,
+)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "tokens": rng.integers(0, 128, (8, 32)).astype(np.int32),
+        "targets": rng.integers(0, 128, (8, 32)).astype(np.int32),
+    }
+
+
+def _trainer(strategy="dp", axes=None, **kw):
+    model = GPT2(gpt2_config("test", dtype=np.float32))
+    return Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                   mesh=create_mesh(**(axes or {})), strategy=strategy, **kw)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tr = _trainer()
+    batch = _batch()
+    tr.train_step(batch)
+    with CheckpointManager(tmp_path / "ckpt") as mgr:
+        mgr.save(int(tr.state.step), tr.state, force=True)
+        mgr.wait()
+        assert mgr.latest_step() == 1
+        restored = mgr.restore(
+            abstract_state_like(tr.state, tr.state_shardings))
+    for a, b in zip(jax.tree.leaves(tr.state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_onto_different_mesh(tmp_path):
+    """A DP-saved checkpoint restores onto an FSDP mesh (re-sharding on
+    load) and keeps training with the same loss."""
+    batch = _batch()
+    tr_dp = _trainer("dp")
+    tr_dp.train_step(batch)
+    with CheckpointManager(tmp_path / "ckpt") as mgr:
+        mgr.save(1, tr_dp.state, force=True)
+        mgr.wait()
+        loss_dp = float(tr_dp.train_step(batch)["loss"])
+        tr_fsdp = _trainer("fsdp", axes=dict(data=2, fsdp=4))
+        tr_fsdp.init(batch)
+        tr_fsdp.state = mgr.restore(
+            abstract_state_like(tr_fsdp.state, tr_fsdp.state_shardings))
+    loss_fsdp = float(tr_fsdp.train_step(batch)["loss"])
+    np.testing.assert_allclose(loss_fsdp, loss_dp, rtol=1e-5)
+
+
+def test_fit_resume_continues_curve(tmp_path):
+    """1 epoch + resume + 1 epoch == 2 epochs straight (loss equality)."""
+    from pytorchdistributed_tpu.data import (
+        DataLoader,
+        SyntheticTokenDataset,
+    )
+
+    ds = SyntheticTokenDataset(size=64, seq_len=32, vocab_size=128, seed=0)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+
+    straight = _trainer()
+    m_straight = straight.fit(loader, 2)
+
+    resumed = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    resumed.fit(loader, 1)
+    resumed2 = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    m_resumed = resumed2.fit(loader, 2, resume=True)
+    assert int(resumed2.state.step) == int(straight.state.step)
+    np.testing.assert_allclose(m_resumed["loss"], m_straight["loss"],
+                               rtol=1e-5)
+
+
+def test_epoch_end_save_collides_with_interval_save(tmp_path):
+    """Regression: when checkpoint_every_steps divides steps-per-epoch, the
+    epoch-end save lands on an already-saved step and must be a no-op, not
+    a StepAlreadyExistsError crash."""
+    from pytorchdistributed_tpu.data import DataLoader, SyntheticTokenDataset
+
+    ds = SyntheticTokenDataset(size=32, seq_len=32, vocab_size=128, seed=0)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+    assert len(loader) == 4
+    tr = _trainer(checkpoint_dir=str(tmp_path / "ck"),
+                  checkpoint_every_steps=2)
+    tr.fit(loader, 1)  # interval saves at 2,4; epoch-end save also step 4
+    assert tr.checkpoint.latest_step() == 4
+
+
+def test_mid_epoch_resume_no_duplicate_batches(tmp_path):
+    """Regression: resuming from a mid-epoch checkpoint must skip the
+    already-trained prefix of that epoch (same final step and loss as an
+    uninterrupted run)."""
+    from pytorchdistributed_tpu.data import DataLoader, SyntheticTokenDataset
+
+    ds = SyntheticTokenDataset(size=64, seq_len=32, vocab_size=128, seed=0)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+    steps_per_epoch = len(loader)  # 8
+
+    straight = _trainer()
+    m_straight = straight.fit(loader, 2)
+
+    # train 5 steps of epoch 0, checkpoint, "crash"
+    crashed = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    loader.set_epoch(0)
+    for i, batch in enumerate(iter(loader)):
+        crashed.train_step(batch)
+        if i == 4:
+            break
+    crashed._save_checkpoint(force=True)
+    crashed.checkpoint.wait()
+
+    resumed = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    m_resumed = resumed.fit(loader, 2, resume=True)
+    assert int(resumed.state.step) == int(straight.state.step) \
+        == 2 * steps_per_epoch
+    np.testing.assert_allclose(m_resumed["loss"], m_straight["loss"],
+                               rtol=1e-5)

@@ -1,0 +1,96 @@
+"""Launcher tests — both reference entry styles (SURVEY.md §3.1/§3.2) on
+real OS processes, each with its own 1-device CPU sim: the "multi-node
+without a cluster" rig the reference never had (§4 item 4).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pytorchdistributed_tpu.runtime.launch import launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _allgather_worker(rank):
+    # runs in a fresh spawned process: set up its own platform
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+    from pytorchdistributed_tpu.runtime import dist
+
+    dist.init_process_group()
+    assert dist.get_rank() == rank
+    assert dist.get_world_size() == 2
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    got = multihost_utils.process_allgather(jnp.array([dist.get_rank()]))
+    assert got.ravel().tolist() == [0, 1]
+    dist.destroy_process_group()
+
+
+def _failing_worker(rank):
+    if rank == 1:
+        raise SystemExit(3)
+
+
+def test_spawn_style_collective():
+    """The mp.spawn path (reference ddp_gpus.py:98): 2 processes rendezvous
+    via the env contract and complete a cross-process collective."""
+    launch(_allgather_worker, 2, devices_per_proc=1, timeout=180)
+
+
+def test_spawn_style_failure_propagates():
+    with pytest.raises(RuntimeError, match="rank 1 failed"):
+        launch(_failing_worker, 2, devices_per_proc=1, timeout=60)
+
+
+def test_torchrun_style_cli(tmp_path):
+    """The torchrun path (reference ddp_gpus_torchrun.py:102): the run CLI
+    sets the env contract; the script reads it via init_process_group."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {REPO!r})
+        from pytorchdistributed_tpu.runtime import dist
+        dist.init_process_group()
+        rank = dist.get_rank()
+        assert os.environ["RANK"] == str(rank)
+        assert dist.get_world_size() == 2
+        dist.barrier("test")
+        dist.destroy_process_group()
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorchdistributed_tpu.run",
+         "--nproc-per-node", "2", "--devices-per-proc", "1", str(script)],
+        cwd=REPO, timeout=240, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_torchrun_style_elastic_restart(tmp_path):
+    """Fault injection (SURVEY.md §5): rank 0 dies on the first incarnation,
+    the agent relaunches the group, second incarnation succeeds."""
+    marker = tmp_path / "died_once"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if os.environ["RANK"] == "0" and not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(17)  # simulated failure, pre-rendezvous
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorchdistributed_tpu.run",
+         "--nproc-per-node", "2", "--max-restarts", "1", str(script)],
+        cwd=REPO, timeout=120, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "restart 1/1" in proc.stderr

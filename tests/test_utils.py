@@ -1,0 +1,62 @@
+"""Guards + meters tests (SURVEY.md §5 sanitizers/metrics)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from pytorchdistributed_tpu.utils import (
+    NaNWatchdog,
+    StepTimer,
+    ThroughputMeter,
+    assert_finite,
+    assert_replicas_consistent,
+    scaling_efficiency,
+)
+
+
+def test_assert_finite_names_offending_leaf():
+    tree = {"ok": jnp.ones(3), "bad": {"w": jnp.array([1.0, np.nan])}}
+    with pytest.raises(FloatingPointError, match="bad.*w"):
+        assert_finite(tree, name="params")
+    assert_finite({"ok": jnp.ones(3)})  # no raise
+    assert_finite({"ints": jnp.arange(3)})  # non-float leaves skipped
+
+
+def test_nan_watchdog():
+    wd = NaNWatchdog()
+    wd.check({"loss": 1.0})
+    with pytest.raises(FloatingPointError, match="loss"):
+        wd.check({"loss": float("inf")})
+
+
+def test_replicas_consistent_single_process_noop():
+    assert_replicas_consistent({"w": jnp.ones(2)})
+
+
+def test_step_timer_discards_warmup():
+    t = StepTimer(warmup=1)
+    for _ in range(3):
+        with t:
+            pass
+    assert len(t._times) == 2
+    assert np.isfinite(t.mean)
+
+
+def test_timeit_reference_methodology():
+    mean, std = StepTimer.timeit(lambda: None, repeat=5)
+    assert mean >= 0 and std >= 0
+
+
+def test_throughput_meter():
+    m = ThroughputMeter(warmup=0)
+    import time
+    m.update(100)
+    time.sleep(0.01)
+    m.update(100)
+    assert m.rate > 0
+
+
+def test_scaling_efficiency():
+    assert scaling_efficiency(800.0, 100.0, 8) == pytest.approx(1.0)
+    assert scaling_efficiency(720.0, 100.0, 8) == pytest.approx(0.9)
+    assert np.isnan(scaling_efficiency(1.0, 0.0, 8))
