@@ -2,16 +2,22 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Current flagship benchmark: MLP data-parallel training throughput on the
-available chip(s), methodology matching the reference's harness
-(`timeit.repeat(number=1, repeat=N)` mean over identical epochs,
-03_model_parallel.ipynb:403-423). The reference publishes no absolute
-numbers (BASELINE.md), so vs_baseline is self-relative: the first recorded
-run writes `bench_baseline.json` and subsequent runs report value/baseline.
+Default flagship: GPT-2-small causal-LM training throughput (tokens/s) on
+the available chip(s) — bf16 compute on the MXU, Pallas flash attention,
+adamw, the jitted Trainer hot loop. Other modes (--bench): "mlp" (the
+original smoke), "resnet50" (BASELINE config[1] img/s), "sweep" (the
+reference's pipeline split-size sweep shape, 03_model_parallel.ipynb:586-623).
+
+Methodology matches the reference's harness (`timeit.repeat`-style: timed
+repeats after a compile warmup, mean reported; 03_model_parallel.ipynb:
+403-423). The reference publishes no absolute numbers (BASELINE.md), so
+vs_baseline is self-relative: the first recorded run writes
+`bench_baseline.json`; later runs report value/baseline.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import pathlib
 import time
@@ -31,8 +37,81 @@ def _vs_baseline(metric: str, value: float) -> float:
     return round(value / baselines[metric], 3)
 
 
-def main() -> None:
+def _time_steps(trainer, batch, *, warmup: int = 2, steps: int = 20) -> float:
+    """Seconds per step, post-compile. Synchronization is by *forcing a
+    metric value* (float()), not block_until_ready: through the axon TPU
+    tunnel block_until_ready has been observed to return without fencing
+    the async dispatch queue, inflating throughput ~100x."""
+    from pytorchdistributed_tpu.data.loader import shard_batch
+
+    if trainer.state is None:
+        trainer.init(batch)
+    batch = shard_batch(batch, trainer.batch_sharding)  # one H2D, not per step
+    metrics = None
+    for _ in range(warmup):
+        metrics = trainer.train_step(batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metrics = trainer.train_step(batch)
+    float(metrics["loss"])  # forces the whole chain
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_gpt2() -> dict:
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
     import jax
+    batch_size, seq_len = 8, 1024
+    attention = "pallas" if jax.default_backend() == "tpu" else "dense"
+    # remat: without it the 12-layer scan keeps every layer's activations
+    # live and the step thrashes HBM (measured 18x slower on v5e)
+    model = GPT2(gpt2_config("small", attention=attention, remat=True))
+    trainer = Trainer(model, optax.adamw(3e-4), token_cross_entropy_loss,
+                      mesh=create_mesh(), strategy="dp", log_every=10**9)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, 50257, (batch_size, seq_len)).astype(
+            np.int32),
+        "targets": rng.integers(0, 50257, (batch_size, seq_len)).astype(
+            np.int32),
+    }
+    sec = _time_steps(trainer, batch)
+    tokens_per_s = batch_size * seq_len / sec
+    return {"metric": "gpt2s_train_tokens_per_s",
+            "value": round(tokens_per_s, 1), "unit": "tokens/s"}
+
+
+def bench_resnet50() -> dict:
+    import optax
+
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
+
+    batch_size = 64
+    trainer = Trainer(resnet50(), optax.sgd(0.1, momentum=0.9),
+                      cross_entropy_loss, mesh=create_mesh(),
+                      strategy="dp", log_every=10**9)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.standard_normal(
+            (batch_size, 224, 224, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
+    }
+    sec = _time_steps(trainer, batch, steps=10)
+    return {"metric": "resnet50_train_img_per_s",
+            "value": round(batch_size / sec, 1), "unit": "img/s"}
+
+
+def bench_mlp() -> dict:
     import optax
 
     from pytorchdistributed_tpu.data import (
@@ -47,36 +126,71 @@ def main() -> None:
     model = MLP(features=(1024, 1024, 256))
     ds = SyntheticRegressionDataset(size=batch_size * 4, in_dim=256,
                                     out_dim=256, seed=0)
-    mesh = create_mesh()
-    trainer = Trainer(model, optax.adamw(1e-3), mse_loss, mesh=mesh,
-                      strategy="dp", log_every=10**9)
+    trainer = Trainer(model, optax.adamw(1e-3), mse_loss,
+                      mesh=create_mesh(), strategy="dp", log_every=10**9)
     loader = DataLoader(ds, batch_size=batch_size, num_replicas=1, rank=0)
-
-    # Warmup (compile).
     batch = next(iter(loader))
-    trainer.train_step(batch)
-    jax.block_until_ready(trainer.state.params)
+    sec = _time_steps(trainer, batch)
+    return {"metric": "mlp_dp_training_throughput",
+            "value": round(batch_size / sec, 1), "unit": "samples/s"}
 
-    repeats, steps = 5, 8
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for batch in loader:
-            trainer.train_step(batch)
-        for _ in range(steps - len(loader)):
-            trainer.train_step(batch)
-        jax.block_until_ready(trainer.state.params)
-        times.append(time.perf_counter() - t0)
-    mean_t = float(np.mean(times))
-    samples_per_s = batch_size * max(len(loader), steps) / mean_t
 
-    metric = "mlp_dp_training_throughput"
-    print(json.dumps({
-        "metric": metric,
-        "value": round(samples_per_s, 1),
-        "unit": "samples/s",
-        "vs_baseline": _vs_baseline(metric, samples_per_s),
-    }))
+def bench_sweep() -> dict:
+    """The reference's split-size tradeoff sweep
+    (03_model_parallel.ipynb:586-623): step time vs pipeline micro-batch
+    count for a 2-stage GPT-2 on a 2-way pipe mesh. Always runs on a
+    2-device CPU sim (the bench host has one TPU chip; the env override
+    must happen before the first backend initialization, so no device
+    query can precede it). Reports the best micro-batch count's
+    throughput; the full table goes to stderr."""
+    import os
+    import sys
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, 512, (32, 128)).astype(np.int32),
+        "targets": rng.integers(0, 512, (32, 128)).astype(np.int32),
+    }
+    results = {}
+    for m in [1, 2, 4, 8, 16, 32]:
+        model = GPT2(gpt2_config(
+            "test", num_layers=4, vocab_size=512,
+            pipeline_stages=2, pipeline_microbatches=m))
+        tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                     mesh=create_mesh(pipe=2), strategy="dp",
+                     log_every=10**9)
+        results[m] = _time_steps(tr, batch, warmup=1, steps=5)
+    best = min(results, key=results.get)
+    print(f"sweep step seconds: {results} (best microbatches={best})",
+          file=sys.stderr, flush=True)
+    return {"metric": "pp_sweep_best_tokens_per_s",
+            "value": round(32 * 128 / results[best], 1), "unit": "tokens/s"}
+
+
+BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50, "mlp": bench_mlp,
+           "sweep": bench_sweep}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--bench", choices=sorted(BENCHES), default="gpt2")
+    args = parser.parse_args()
+    result = BENCHES[args.bench]()
+    result["vs_baseline"] = _vs_baseline(result["metric"], result["value"])
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -105,10 +105,25 @@ def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
         raise ValueError(f"batch {b} not divisible by "
                          f"num_microbatches {m}")
     mb = b // m
+    # Promote the invariant→varying boundary to fp32 explicitly: its
+    # transpose is a psum of x's cotangents over "pipe", and XLA:CPU's
+    # AllReducePromotion pass crashes on sub-fp32 all-reduces (the TPU
+    # backend would promote it to fp32 anyway).
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+        x = lax.pcast(x.astype(jnp.float32), Axis.PIPE,
+                      to="varying").astype(x.dtype)
+    else:
+        x = lax.pcast(x, Axis.PIPE, to="varying")
     x_mb = x.reshape(m, mb, *x.shape[1:])
 
-    acts0 = lax.pcast(jnp.zeros_like(x_mb[0]), Axis.PIPE, to="varying")
-    outs0 = lax.pcast(jnp.zeros_like(x_mb), Axis.PIPE, to="varying")
+    # pcast in fp32, cast after: a sub-fp32 pcast lowers to a copy-reduction
+    # all-reduce that XLA:CPU's AllReducePromotion pass crashes cloning
+    def varying_zeros(shape, dtype):
+        z = lax.pcast(jnp.zeros(shape, jnp.float32), Axis.PIPE, to="varying")
+        return z.astype(dtype)
+
+    acts0 = varying_zeros(x_mb[0].shape, x.dtype)
+    outs0 = varying_zeros(x_mb.shape, x.dtype)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def tick(carry, t):
@@ -128,7 +143,9 @@ def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
 
     (_, outs), _ = lax.scan(tick, (acts0, outs0), jnp.arange(m + p - 1))
     # only stage p-1 holds real outputs; psum over "pipe" replicates them
-    # (and marks the result invariant over the axis for the out_spec)
-    outs = lax.psum(
-        jnp.where(my_stage == p - 1, outs, jnp.zeros_like(outs)), Axis.PIPE)
+    # (and marks the result invariant over the axis for the out_spec).
+    # fp32 for the wire: XLA promotes sub-fp32 all-reduces anyway, and its
+    # CPU backend crashes doing so (AllReducePromotion on bf16).
+    masked = jnp.where(my_stage == p - 1, outs, jnp.zeros_like(outs))
+    outs = lax.psum(masked.astype(jnp.float32), Axis.PIPE).astype(outs.dtype)
     return outs.reshape(b, *outs.shape[2:])
