@@ -143,13 +143,11 @@ def bench_sweep() -> dict:
     must happen before the first backend initialization, so no device
     query can precede it). Reports the best micro-batch count's
     throughput; the full table goes to stderr."""
-    import os
     import sys
 
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=2")
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    from pytorchdistributed_tpu.config import select_backend
+
+    select_backend("cpu-sim2")  # env + jax.config, before backend init
     import optax
 
     from pytorchdistributed_tpu.models import GPT2, gpt2_config

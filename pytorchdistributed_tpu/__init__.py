@@ -18,6 +18,21 @@ framework code.
 
 __version__ = "0.1.0"
 
+# Re-assert the standard JAX_PLATFORMS env contract: some environments
+# (e.g. a sitecustomize registering a TPU-tunnel plugin) import jax before
+# user code runs, baking their platform choice into jax.config so the env
+# var the user set is silently ignored. Harmless when no backend is
+# initialized yet; no-op otherwise.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 from pytorchdistributed_tpu.runtime.mesh import (  # noqa: F401
     Axis,
     MeshConfig,

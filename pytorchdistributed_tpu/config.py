@@ -1,0 +1,217 @@
+"""Config / flag system (SURVEY.md §5: "dataclass configs + CLI overrides;
+a --backend/mesh flag selecting {cpu-sim, single-TPU, pod}" — the north
+star's "entrypoints select the TPU backend via a flag").
+
+The reference's whole config surface is two argparse flags
+(--max_epochs/--batch_size, ddp_gpus.py:88-92) with topology implied by
+`torch.cuda.device_count()`. Here one dataclass covers model choice,
+parallelism axes, precision and training hyperparameters; any field is
+overridable from the CLI (`--field value`), and `PRESETS` carries the five
+BASELINE.json benchmark configs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    # model
+    model: str = "gpt2"            # gpt2 | bert | vit | resnet18 | resnet50 | mlp
+    model_size: str = "test"       # per-family size preset
+    attention: str = "dense"       # dense | pallas | ring | ulysses
+    remat: bool = False
+    # parallelism (mesh axis sizes; -1 = absorb remaining devices)
+    strategy: str = "dp"           # dp | fsdp | tp | tp_fsdp
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    seq: int = 1
+    num_slices: int = 1
+    pipeline_microbatches: int = 1
+    # precision
+    bf16: bool = True
+    # training
+    max_epochs: int = 1
+    batch_size: int = 32           # per-process
+    learning_rate: float = 1e-3
+    optimizer: str = "adamw"       # adamw | sgd
+    seed: int = 0
+    # data shapes (synthetic datasets)
+    dataset_size: int = 2048
+    seq_len: int = 128
+    image_size: int = 32
+    num_classes: int = 10
+    # infra
+    backend: str = "auto"          # auto | tpu | cpu-sim<N>
+    checkpoint_dir: str = ""
+    checkpoint_every_steps: int = 0
+    resume: bool = False
+    log_every: int = 10
+
+
+# The five BASELINE.json benchmark configs, smallest to largest.
+PRESETS: dict[str, dict[str, Any]] = {
+    # configs[0]: ResNet-18 / CIFAR-10 CPU smoke (the "gloo smoke" analog)
+    "resnet18_cifar_smoke": dict(
+        model="resnet18", backend="cpu-sim8", image_size=32, num_classes=10,
+        strategy="dp", batch_size=32, bf16=False),
+    # configs[1]: ResNet-50 / ImageNet multi-process DP
+    "resnet50_imagenet_dp": dict(
+        model="resnet50", image_size=224, num_classes=1000, strategy="dp",
+        batch_size=64),
+    # configs[2]: BERT-base MLM, bf16
+    "bert_base_mlm": dict(
+        model="bert", model_size="base", seq_len=512, strategy="dp",
+        batch_size=16, bf16=True),
+    # configs[3]: GPT-2-medium FSDP + activation checkpointing
+    "gpt2_medium_fsdp": dict(
+        model="gpt2", model_size="medium", seq_len=1024, strategy="fsdp",
+        data=1, fsdp=-1, remat=True, batch_size=8),
+    # configs[4]: ViT-L/16 multi-host DP across pod slices
+    "vit_l16_multihost": dict(
+        model="vit", model_size="large", image_size=224, num_classes=1000,
+        strategy="dp", num_slices=2, batch_size=32),
+}
+
+
+def select_backend(backend: str) -> None:
+    """Apply the --backend flag. MUST run before the first JAX backend
+    initialization (any jax.devices() call)."""
+    if backend == "auto":
+        return
+    if backend == "tpu":
+        os.environ.pop("JAX_PLATFORMS", None)
+        return
+    if backend.startswith("cpu-sim"):
+        n = int(backend[len("cpu-sim"):] or "8")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n}").strip()
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
+        return
+    raise ValueError(f"unknown backend {backend!r} "
+                     "(use auto | tpu | cpu-sim<N>)")
+
+
+def parse_cli(argv=None) -> ExperimentConfig:
+    """Every dataclass field becomes a --flag; --preset applies a BASELINE
+    config first, explicit flags override it."""
+    parser = argparse.ArgumentParser(description="tpu-distributed training")
+    parser.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    for f in dataclasses.fields(ExperimentConfig):
+        if f.type == "bool":
+            parser.add_argument(f"--{f.name}", type=lambda s: s.lower() in
+                                ("1", "true", "yes"), default=None,
+                                metavar="BOOL")
+        else:
+            parser.add_argument(f"--{f.name}",
+                                type=type(f.default), default=None)
+    ns = parser.parse_args(argv)
+    values: dict[str, Any] = {}
+    if ns.preset:
+        values.update(PRESETS[ns.preset])
+    for f in dataclasses.fields(ExperimentConfig):
+        v = getattr(ns, f.name)
+        if v is not None:
+            values[f.name] = v
+    return ExperimentConfig(**values)
+
+
+def build(cfg: ExperimentConfig):
+    """(model, optimizer, loss_fn, mesh, dataset) from a config. Imports jax
+    lazily so select_backend can act first."""
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchdistributed_tpu import models
+    from pytorchdistributed_tpu.data import (
+        SyntheticImageDataset,
+        SyntheticRegressionDataset,
+        SyntheticTokenDataset,
+    )
+    from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh
+    from pytorchdistributed_tpu.training import (
+        cross_entropy_loss,
+        mse_loss,
+        token_cross_entropy_loss,
+    )
+
+    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
+               pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
+               pipeline_microbatches=cfg.pipeline_microbatches)
+
+    if cfg.model == "gpt2":
+        model = models.GPT2(models.gpt2_config(
+            cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
+        loss = token_cross_entropy_loss
+        ds = SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
+                                   model.cfg.vocab_size, cfg.seed)
+    elif cfg.model == "bert":
+        model = models.BertMLM(models.bert_config(
+            cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
+        loss = token_cross_entropy_loss
+        ds = SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
+                                   model.cfg.vocab_size, cfg.seed)
+    elif cfg.model == "vit":
+        model = models.ViT(models.vit_config(
+            cfg.model_size, image_size=cfg.image_size,
+            num_classes=cfg.num_classes, **tkw))
+        loss = cross_entropy_loss
+        ds = SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
+                                   num_classes=cfg.num_classes, seed=cfg.seed)
+    elif cfg.model in ("resnet18", "resnet50"):
+        maker = models.resnet18 if cfg.model == "resnet18" else models.resnet50
+        model = maker(num_classes=cfg.num_classes, dtype=dtype,
+                      **(dict(cifar_stem=True) if cfg.model == "resnet18"
+                         and cfg.image_size <= 64 else {}))
+        loss = cross_entropy_loss
+        ds = SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
+                                   num_classes=cfg.num_classes, seed=cfg.seed)
+    elif cfg.model == "mlp":
+        model = models.MLP()
+        loss = mse_loss
+        ds = SyntheticRegressionDataset(cfg.dataset_size, seed=cfg.seed)
+    else:
+        raise ValueError(f"unknown model {cfg.model!r}")
+
+    mesh = create_mesh(MeshConfig(
+        data=cfg.data, fsdp=cfg.fsdp, tensor=cfg.tensor, pipe=cfg.pipe,
+        seq=cfg.seq, num_slices=cfg.num_slices))
+    if cfg.optimizer == "adamw":
+        opt = optax.adamw(cfg.learning_rate)
+    elif cfg.optimizer == "sgd":
+        opt = optax.sgd(cfg.learning_rate, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    return model, opt, loss, mesh, ds
+
+
+def make_trainer(cfg: ExperimentConfig):
+    """Fully-wired Trainer + DataLoader for a config."""
+    from pytorchdistributed_tpu.data import DataLoader
+    from pytorchdistributed_tpu.parallel.precision import Policy
+    from pytorchdistributed_tpu.training import Trainer
+
+    model, opt, loss, mesh, ds = build(cfg)
+    loader = DataLoader(ds, batch_size=cfg.batch_size, seed=cfg.seed)
+    trainer = Trainer(
+        model, opt, loss, mesh=mesh, strategy=cfg.strategy,
+        precision=Policy.bf16() if cfg.bf16 else Policy.full(),
+        log_every=cfg.log_every,
+        checkpoint_dir=cfg.checkpoint_dir or None,
+        checkpoint_every_steps=cfg.checkpoint_every_steps,
+    )
+    return trainer, loader

@@ -1,0 +1,47 @@
+"""Config/flag-system tests (SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu.config import (
+    PRESETS,
+    ExperimentConfig,
+    make_trainer,
+    parse_cli,
+)
+
+
+def test_cli_overrides_and_presets():
+    cfg = parse_cli(["--preset", "gpt2_medium_fsdp", "--model_size", "test",
+                     "--batch_size", "4", "--remat", "false"])
+    assert cfg.model == "gpt2"
+    assert cfg.strategy == "fsdp"       # from the preset
+    assert cfg.model_size == "test"     # flag overrides preset
+    assert cfg.batch_size == 4
+    assert cfg.remat is False           # bool flag override
+    assert cfg.fsdp == -1
+
+
+def test_defaults_roundtrip():
+    cfg = parse_cli([])
+    assert cfg == ExperimentConfig()
+
+
+@pytest.mark.parametrize("preset", sorted(PRESETS))
+def test_presets_construct(preset):
+    """Every BASELINE preset must at least build (tiny overrides keep the
+    CPU sim fast; vit multi-slice needs the hybrid mesh so it only builds
+    the config here)."""
+    overrides = ["--model_size", "test", "--dataset_size", "64",
+                 "--seq_len", "32", "--image_size", "32",
+                 "--num_classes", "10", "--batch_size", "8",
+                 "--backend", "auto"]
+    if preset == "vit_l16_multihost":
+        overrides += ["--num_slices", "1"]  # 1 host in the test rig
+    if preset == "resnet50_imagenet_dp":
+        overrides += ["--model", "resnet18"]  # keep the smoke fast
+    cfg = parse_cli(["--preset", preset] + overrides)
+    trainer, loader = make_trainer(cfg)
+    batch = next(iter(loader))
+    m = trainer.train_step(batch)
+    assert np.isfinite(float(m["loss"]))
