@@ -1,0 +1,65 @@
+// Native host-side data path (SURVEY.md §2b: torch's data loader leans on
+// ATen's C++ indexing kernels + pinned-memory copies; the TPU-native analog
+// is this host library feeding jax.device_put).
+//
+// The loader's hot loop is one vectorized gather per batch
+// (data/datasets.py: ds[indices]); numpy's fancy indexing is single-threaded
+// and, for the ~40MB image batches of BASELINE config[1], measurably behind
+// a parallel row copy. This library provides:
+//
+//   ptd_gather    — multi-threaded row gather (any row size, any dtype via
+//                   byte rows)
+//   ptd_version   — ABI check for the ctypes loader
+//
+// Built with `make -C csrc` into pytorchdistributed_tpu/_native/; the
+// Python side (pytorchdistributed_tpu/_native/__init__.py) falls back to
+// numpy when the library is absent, so the framework never hard-depends on
+// the toolchain.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+int32_t ptd_version() { return 1; }
+
+// Gather rows: out[i, :] = src[indices[i], :]; rows are raw bytes
+// (row_bytes = product of trailing dims * itemsize). n_threads <= 0 picks
+// the hardware concurrency, capped so small batches stay single-threaded.
+void ptd_gather(const uint8_t* src, int64_t n_src_rows, int64_t row_bytes,
+                const int64_t* indices, int64_t n_idx, uint8_t* out,
+                int32_t n_threads) {
+  (void)n_src_rows;  // bounds are validated Python-side
+  if (n_threads <= 0) {
+    int64_t by_work = (n_idx * row_bytes) / (1 << 20);  // ~1MB per thread min
+    int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
+    n_threads = static_cast<int32_t>(
+        std::max<int64_t>(1, std::min(hw, by_work)));
+  }
+  if (n_threads <= 1) {
+    for (int64_t i = 0; i < n_idx; ++i) {
+      std::memcpy(out + i * row_bytes, src + indices[i] * row_bytes,
+                  row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(n_idx, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(out + i * row_bytes, src + indices[i] * row_bytes,
+                    row_bytes);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"

@@ -25,8 +25,10 @@ def main():
     parser.add_argument("--batch_size", type=int, default=32)
     args = parser.parse_args()
 
-    if os.environ.get("RANK") is not None:
-        # launched via pytorchdistributed_tpu.run: force the per-proc CPU sim
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # launcher requested the per-proc CPU sim (--devices-per-proc): the
+        # ambient jax pre-import may have baked another platform into config.
+        # On real TPU hosts JAX_PLATFORMS is unset and this is a no-op.
         import jax
         jax.config.update("jax_platforms", "cpu")
 

@@ -1,0 +1,75 @@
+"""ctypes loader for the native host-data-path library (csrc/ptd_host.cc).
+
+`gather(src, indices)` is the loader's hot loop (one call per batch);
+the native path is a multi-threaded row memcpy that releases the GIL
+(ctypes calls drop it), so host batch assembly overlaps device compute.
+Falls back to numpy fancy indexing when the library isn't built — the
+framework never hard-requires the C++ toolchain. Build with:
+
+    make -C csrc
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+_LIB_PATH = pathlib.Path(__file__).parent / "libptd_host.so"
+_CSRC = pathlib.Path(__file__).parent.parent.parent / "csrc"
+_lib = None
+_load_attempted = False
+
+
+def _try_load() -> ctypes.CDLL | None:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True  # one build/load attempt per process, pass or fail
+    if not _LIB_PATH.exists() and (_CSRC / "Makefile").exists():
+        # best-effort one-shot build; stays silent on missing toolchain
+        try:
+            subprocess.run(["make", "-C", str(_CSRC)], capture_output=True,
+                           timeout=120, check=True)
+        except Exception:
+            return None
+    if not _LIB_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        if lib.ptd_version() != 1:
+            return None
+        lib.ptd_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32,
+        ]
+        lib.ptd_gather.restype = None
+        _lib = lib
+    except OSError:
+        return None
+    return _lib
+
+
+def native_available() -> bool:
+    return _try_load() is not None
+
+
+def gather(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """out[i] = src[indices[i]] — native multithreaded when built, numpy
+    otherwise. Bounds are checked here (the C side trusts its caller)."""
+    lib = _try_load()
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    if lib is None or not src.flags.c_contiguous or src.nbytes == 0:
+        return src[indices]
+    if indices.size and (indices.min() < 0 or indices.max() >= len(src)):
+        raise IndexError(
+            f"indices out of range [0, {len(src)}) for gather")
+    out = np.empty((len(indices),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.ptd_gather(
+        src.ctypes.data, len(src), row_bytes,
+        indices.ctypes.data, len(indices), out.ctypes.data, 0)
+    return out

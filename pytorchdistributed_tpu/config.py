@@ -83,18 +83,31 @@ PRESETS: dict[str, dict[str, Any]] = {
 def select_backend(backend: str) -> None:
     """Apply the --backend flag. MUST run before the first JAX backend
     initialization (any jax.devices() call)."""
+    import re
+
     if backend == "auto":
         return
     if backend == "tpu":
         os.environ.pop("JAX_PLATFORMS", None)
+        try:
+            import jax
+            # jax may already be imported with a platform baked into its
+            # config (the package __init__ re-asserts env) — reset to
+            # autodetect, which picks the TPU plugin when present
+            jax.config.update("jax_platforms", None)
+        except ImportError:
+            pass
         return
     if backend.startswith("cpu-sim"):
         n = int(backend[len("cpu-sim"):] or "8")
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={n}").strip()
+        # replace (not keep) any pre-existing count: the explicit backend
+        # request wins over inherited env
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
         try:
             import jax
             jax.config.update("jax_platforms", "cpu")

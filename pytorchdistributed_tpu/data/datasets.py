@@ -36,6 +36,13 @@ class ArrayDataset:
         return self._size
 
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        if isinstance(idx, np.ndarray) and idx.ndim == 1 \
+                and np.issubdtype(idx.dtype, np.integer):
+            # batch gather — the loader's hot loop; native multithreaded
+            # row copy when csrc/ is built (GIL released), numpy otherwise
+            from pytorchdistributed_tpu import _native
+
+            return {k: _native.gather(v, idx) for k, v in self.arrays.items()}
         return {k: v[idx] for k, v in self.arrays.items()}
 
 

@@ -1,0 +1,39 @@
+"""Native host-data-path tests (csrc/ptd_host.cc via ctypes)."""
+
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu import _native
+from pytorchdistributed_tpu.data import SyntheticImageDataset
+
+
+def test_gather_matches_numpy():
+    rng = np.random.default_rng(0)
+    for dtype in (np.float32, np.int32, np.uint8):
+        src = (rng.standard_normal((128, 7, 5)) * 100).astype(dtype)
+        idx = rng.integers(0, 128, 33)
+        np.testing.assert_array_equal(_native.gather(src, idx), src[idx])
+
+
+def test_gather_bounds_checked():
+    if not _native.native_available():
+        pytest.skip("native library not built")
+    src = np.zeros((4, 3), np.float32)
+    with pytest.raises(IndexError):
+        _native.gather(src, np.array([4]))
+    with pytest.raises(IndexError):
+        _native.gather(src, np.array([-1]))
+
+
+def test_gather_non_contiguous_falls_back():
+    src = np.asfortranarray(np.arange(24, dtype=np.float32).reshape(4, 6))
+    idx = np.array([2, 0])
+    np.testing.assert_array_equal(_native.gather(src, idx), src[idx])
+
+
+def test_dataset_batch_uses_gather_path():
+    ds = SyntheticImageDataset(size=64, image_size=8, seed=0)
+    idx = np.array([5, 1, 63])
+    batch = ds[idx]
+    np.testing.assert_array_equal(batch["image"], ds.arrays["image"][idx])
+    np.testing.assert_array_equal(batch["label"], ds.arrays["label"][idx])
