@@ -1,20 +1,31 @@
-"""Flash attention as a Pallas TPU kernel (SURVEY.md §5: "blockwise /
+"""Flash attention as Pallas TPU kernels (SURVEY.md §5: "blockwise /
 Flash-style Pallas attention kernel").
 
 Forward: one fused kernel, grid (batch·heads, q_blocks, k_blocks). The
 online-softmax accumulator (m, l, acc) lives in VMEM scratch and is carried
 across the sequentially-executed k_blocks grid dimension; HBM traffic is one
 read of each Q/K/V block and one write of each O block — the flash
-recurrence. Causal blocks strictly above the diagonal are masked (their
-contribution is exactly zero).
+recurrence. The per-row logsumexp (LSE = m + log l) is written out as a
+second kernel output; it is the only softmax statistic the backward needs.
 
-Backward: `jax.custom_vjp` whose bwd recomputes attention blockwise in plain
-JAX (a `lax.scan` flash recurrence XLA fuses well) and differentiates that —
-activation-recompute semantics (no S×S residuals stored), numerically
-identical gradients.
+Backward: two fused Pallas kernels under `jax.custom_vjp`, the
+FlashAttention-2 split:
 
-On non-TPU backends (the CPU test sim) the kernel runs in Pallas interpret
-mode automatically.
+  * dKV kernel, grid (batch·heads, k_blocks, q_blocks): for its K/V block,
+    scans Q/dO blocks accumulating  dV = Pᵀ·dO  and  dK = dSᵀ·Q  in VMEM
+    scratch, where  P = exp(S − LSE)  is recomputed from Q·Kᵀ (no S×S
+    residual is ever stored) and  dS = P ∘ (dP − Δ)·scale  with
+    dP = dO·Vᵀ and the precomputed row statistic Δ = rowsum(dO ∘ O);
+  * dQ kernel, grid (batch·heads, q_blocks, k_blocks): same recompute,
+    accumulating  dQ = dS·K  across K blocks.
+
+Residuals are (Q, K, V, O, LSE) — O(s·d) memory, gradients numerically
+identical to dense attention (tests/test_attention.py).
+
+Causal blocks strictly above the diagonal are skipped in all three kernels
+(their contribution is exactly zero). Padded Q/K tails (seq_len not
+divisible by the block size) are masked. On non-TPU backends (the CPU test
+sim) the kernels run in Pallas interpret mode automatically.
 """
 
 from __future__ import annotations
@@ -29,7 +40,7 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 block_q: int, block_k: int, causal: bool, scale: float,
                 num_k_blocks: int, seq_len: int):
     ki = pl.program_id(2)
@@ -81,8 +92,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)      # [bq, 1]
 
 
 def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
@@ -104,8 +116,18 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            # row statistics ride as [bh, s, 1] with block (1, block_q, 1):
+            # the trailing 1 equals the array dim, so the TPU tiling
+            # constraint reduces to block_q % 8 == 0 — identical to the Q
+            # block's own constraint (a rank-2 [bh, s] slice can't satisfy it)
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
         scratch_shapes=[
             _vmem_scratch((block_q, d)),
             _vmem_scratch((block_q, 1)),
@@ -120,65 +142,200 @@ def _vmem_scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-def _blockwise_reference(q, k, v, *, causal: bool, scale: float,
-                         block_k: int = 512):
-    """Flash recurrence in plain JAX ([bh, s, d] layout) — the recompute
-    target the custom bwd differentiates; O(s·block_k) memory via lax.scan."""
+def _zero_pad_rows(x, start, seq_len):
+    """Zero rows of a [rows, d] block that fall beyond seq_len: padded tail
+    blocks load unspecified garbage (NaN in interpret mode), and a matmul
+    against even a zeroed operand turns 0·NaN into NaN."""
+    pos = start + lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(pos < seq_len, x, 0.0)
+
+
+def _recompute_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk, *,
+                    scale, causal, q_start, k_start, seq_len):
+    """Shared bwd math: rebuild P = exp(S − LSE) for one (q, k) block pair
+    and form dS = P ∘ (dO·Vᵀ − Δ)·scale. lse_blk/delta_blk are [bq, 1]
+    column statistics. Returns (p, ds), both [bq, bk] fp32, zero on masked
+    (padded / acausal) positions."""
+    s_blk = jax.lax.dot_general(
+        q_blk * scale, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [bq, bk]
+    shape = s_blk.shape
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = k_start + lax.broadcasted_iota(jnp.int32, shape, 1)
+    valid = (q_pos < seq_len) & (k_pos < seq_len)
+    if causal:
+        valid = valid & (q_pos >= k_pos)
+    p = jnp.where(valid, jnp.exp(s_blk - lse_blk), 0.0)    # lse: [bq, 1]
+    dp = jax.lax.dot_general(
+        do_blk, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [bq, bk]
+    # where, not rely on p==0: on masked rows dp/Δ hold garbage from padded
+    # tail blocks, and 0·NaN = NaN
+    ds = jnp.where(valid, p * (dp - delta_blk) * scale, 0.0)
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    block_q: int, block_k: int, causal: bool, scale: float,
+                    num_q_blocks: int, seq_len: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        # this K block only sees Q rows at or below the diagonal
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = _zero_pad_rows(q_ref[0].astype(jnp.float32), q_start, seq_len)
+        k = _zero_pad_rows(k_ref[0].astype(jnp.float32), k_start, seq_len)
+        v = _zero_pad_rows(v_ref[0].astype(jnp.float32), k_start, seq_len)
+        do = _zero_pad_rows(do_ref[0].astype(jnp.float32), q_start, seq_len)
+        p, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            causal=causal, q_start=q_start, k_start=k_start, seq_len=seq_len)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # pᵀ·dO [bk, d]
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # dsᵀ·q [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *,
+                   block_q: int, block_k: int, causal: bool, scale: float,
+                   num_k_blocks: int, seq_len: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = _zero_pad_rows(q_ref[0].astype(jnp.float32), q_start, seq_len)
+        k = _zero_pad_rows(k_ref[0].astype(jnp.float32), k_start, seq_len)
+        v = _zero_pad_rows(v_ref[0].astype(jnp.float32), k_start, seq_len)
+        do = _zero_pad_rows(do_ref[0].astype(jnp.float32), q_start, seq_len)
+        _, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            causal=causal, q_start=q_start, k_start=k_start, seq_len=seq_len)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # ds·k [bq, d]
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
+               block_q: int, block_k: int, interpret: bool):
     bh, s, d = q.shape
+    block_q = min(block_q, s)
     block_k = min(block_k, s)
-    nk = s // block_k if s % block_k == 0 else -(-s // block_k)
-    pad = nk * block_k - s
-    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
-    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-    q32 = q.astype(jnp.float32) * scale
-    q_pos = jnp.arange(s)
+    nq, nk = pl.cdiv(s, block_q), pl.cdiv(s, block_k)
 
-    def step(carry, i):
-        o, m, l = carry
-        k_blk = lax.dynamic_slice_in_dim(kp, i * block_k, block_k, 1)
-        v_blk = lax.dynamic_slice_in_dim(vp, i * block_k, block_k, 1)
-        logits = jnp.einsum("bqd,bkd->bqk", q32, k_blk.astype(jnp.float32))
-        k_pos = i * block_k + jnp.arange(block_k)
-        valid = k_pos < s
-        if causal:
-            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
-        else:
-            valid = jnp.broadcast_to(valid[None, :], (s, block_k))
-        logits = jnp.where(valid[None], logits, _NEG_INF)
-        blk_max = jnp.max(logits, -1)
-        m_new = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - m_new)
-        p = jnp.where(valid[None], jnp.exp(logits - m_new[..., None]), 0.0)
-        l_new = l * corr + p.sum(-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bqk,bkd->bqd", p, v_blk.astype(jnp.float32))
-        return (o_new, m_new, l_new), None
+    # Δ_i = dOᵢ·Oᵢ — tiny elementwise reduce; XLA fuses it into the
+    # surrounding graph, no reason to burn a kernel launch on it. Shaped
+    # [bh, s, 1] to match the LSE layout (see _flash_fwd out_specs).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
-    o0 = jnp.zeros((bh, s, d), jnp.float32)
-    m0 = jnp.full((bh, s), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bh, s), jnp.float32)
-    (o, m, l), _ = lax.scan(step, (o0, m0, l0), jnp.arange(nk))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    # dKV: grid (bh, k_blocks, q_blocks) — q is the sequential inner dim.
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, num_q_blocks=nq, seq_len=s)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((block_k, d)),
+            _vmem_scratch((block_k, d)),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dQ: grid (bh, q_blocks, k_blocks) — k is the sequential inner dim.
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, num_k_blocks=nk, seq_len=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_vmem_scratch((block_q, d))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
-                      block_k=block_k, interpret=interpret)
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_reference(q, k, v, causal=causal,
-                                             scale=scale, block_k=block_k),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
