@@ -11,30 +11,67 @@ reference's pipeline split-size sweep shape, 03_model_parallel.ipynb:586-623).
 Methodology matches the reference's harness (`timeit.repeat`-style: timed
 repeats after a compile warmup, mean reported; 03_model_parallel.ipynb:
 403-423). The reference publishes no absolute numbers (BASELINE.md), so
-vs_baseline is self-relative: the first recorded run writes
-`bench_baseline.json`; later runs report value/baseline.
+vs_baseline compares against COMMITTED absolute targets (the round-1
+measurements recorded in BASELINE.md) — a number this harness can never
+quietly move. The GPT-2 bench additionally reports MFU from the analytic
+model-FLOPs formula so the utilization claim is checkable.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import pathlib
 import time
 
 import numpy as np
 
-_BASELINE_FILE = pathlib.Path(__file__).parent / "bench_baseline.json"
+# Absolute committed baselines (BASELINE.md "Recorded absolute numbers"):
+# round-1 single-v5e-chip results this build must beat. Fixed in source on
+# purpose — a file the bench writes itself can never look slow.
+COMMITTED_BASELINES = {
+    "gpt2s_train_tokens_per_s": 43381.7,   # BENCH_r01.json
+    "resnet50_train_img_per_s": 2058.6,    # round-1 bench_baseline.json
+    "pp_sweep_best_tokens_per_s": 4138.0,  # round-1 bench_baseline.json
+}
 
 
-def _vs_baseline(metric: str, value: float) -> float:
-    baselines = {}
-    if _BASELINE_FILE.exists():
-        baselines = json.loads(_BASELINE_FILE.read_text())
-    if metric not in baselines:
-        baselines[metric] = value
-        _BASELINE_FILE.write_text(json.dumps(baselines, indent=1))
-    return round(value / baselines[metric], 3)
+def _vs_baseline(metric: str, value: float) -> float | None:
+    if metric not in COMMITTED_BASELINES:
+        return None
+    return round(value / COMMITTED_BASELINES[metric], 3)
+
+
+# Peak bf16 matmul throughput per chip, by jax device_kind. Used only to
+# report MFU; unknown kinds simply omit it.
+_PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _mfu(flops_per_step: float, sec_per_step: float) -> float | None:
+    import jax
+
+    peak = _PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
+    if peak is None:
+        return None
+    return round(flops_per_step / sec_per_step / peak, 4)
+
+
+def transformer_train_flops_per_token(cfg) -> float:
+    """Analytic model FLOPs per trained token (fwd+bwd = 3x fwd):
+    6 x matmul-params (q/k/v/o + MLP per layer, plus the vocab projection)
+    + the attention score/value matmuls 12·L·S·E, halved when causal (the
+    flash kernel skips acausal blocks — we count FLOPs actually executed)."""
+    e, l, s, v = cfg.embed_dim, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
+    matmul_params = l * 12 * e * e + e * v
+    attn = 12 * l * s * e * (0.5 if cfg.causal else 1.0)
+    return 6 * matmul_params + attn
 
 
 def _time_steps(trainer, batch, *, warmup: int = 2, steps: int = 20) -> float:
@@ -71,9 +108,13 @@ def bench_gpt2() -> dict:
     import jax
     batch_size, seq_len = 8, 1024
     attention = "pallas" if jax.default_backend() == "tpu" else "dense"
-    # remat: without it the 12-layer scan keeps every layer's activations
-    # live and the step thrashes HBM (measured 18x slower on v5e)
-    model = GPT2(gpt2_config("small", attention=attention, remat=True))
+    # Fastest measured v5e config: layers unrolled (the 12-iteration scan
+    # costs ~8% in while-loop scheduling) and no remat — GPT-2-small at
+    # batch 8 fits v5e HBM without recompute. remat="dots" is the fallback
+    # for bigger models/batches (config.py).
+    cfg = gpt2_config("small", attention=attention, remat=False,
+                      scan_layers=False)
+    model = GPT2(cfg)
     trainer = Trainer(model, optax.adamw(3e-4), token_cross_entropy_loss,
                       mesh=create_mesh(), strategy="dp", log_every=10**9)
     rng = np.random.default_rng(0)
@@ -84,9 +125,13 @@ def bench_gpt2() -> dict:
             np.int32),
     }
     sec = _time_steps(trainer, batch)
-    tokens_per_s = batch_size * seq_len / sec
-    return {"metric": "gpt2s_train_tokens_per_s",
-            "value": round(tokens_per_s, 1), "unit": "tokens/s"}
+    tokens = batch_size * seq_len
+    result = {"metric": "gpt2s_train_tokens_per_s",
+              "value": round(tokens / sec, 1), "unit": "tokens/s"}
+    mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
+    if mfu is not None:
+        result["mfu"] = mfu
+    return result
 
 
 def bench_resnet50() -> dict:
@@ -187,7 +232,9 @@ def main() -> None:
     parser.add_argument("--bench", choices=sorted(BENCHES), default="gpt2")
     args = parser.parse_args()
     result = BENCHES[args.bench]()
-    result["vs_baseline"] = _vs_baseline(result["metric"], result["value"])
+    vs = _vs_baseline(result["metric"], result["value"])
+    if vs is not None:  # metrics without a committed baseline omit the ratio
+        result["vs_baseline"] = vs
     print(json.dumps(result))
 
 

@@ -62,11 +62,14 @@ def gather(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
     otherwise. Bounds are checked here (the C side trusts its caller)."""
     lib = _try_load()
     indices = np.ascontiguousarray(indices, dtype=np.int64)
-    if lib is None or not src.flags.c_contiguous or src.nbytes == 0:
-        return src[indices]
+    # Bounds check BEFORE choosing a path so semantics don't depend on
+    # build state: numpy fancy indexing would silently wrap negative
+    # indices that the native path rejects.
     if indices.size and (indices.min() < 0 or indices.max() >= len(src)):
         raise IndexError(
             f"indices out of range [0, {len(src)}) for gather")
+    if lib is None or not src.flags.c_contiguous or src.nbytes == 0:
+        return src[indices]
     out = np.empty((len(indices),) + src.shape[1:], dtype=src.dtype)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     lib.ptd_gather(

@@ -33,6 +33,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
@@ -62,11 +63,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        # Dots stay in the input dtype (bf16 on the training path) with fp32
+        # accumulation — upcasting operands first would push the matmul off
+        # the MXU's fast path (fp32 matmul is ~4x slower on TPU). The scale
+        # is applied to the fp32 logits, not the operands.
+        q = q_ref[0]                                      # [bq, d]
+        k = k_ref[0]                                      # [bk, d]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bq, bk]
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
         # mask the padded K tail (seq_len not divisible by block_k) and,
         # for causal, positions above the diagonal
         k_pos = k_start + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -82,12 +87,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)  # [bq, bk]
         l_ref[...] = l_ref[...] * corr + jnp.sum(p, -1, keepdims=True)
         m_ref[...] = m_new
-        v = v_ref[0].astype(jnp.float32)                   # [bk, d]
+        v = v_ref[0]                                       # [bk, d]
         # zero the padded V tail: p is 0 there, but 0·garbage(NaN) = NaN
         v_pos = k_start + lax.broadcasted_iota(jnp.int32, v.shape, 0)
-        v = jnp.where(v_pos < seq_len, v, 0.0)
+        v = jnp.where(v_pos < seq_len, v, jnp.zeros_like(v))
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
@@ -147,18 +152,19 @@ def _zero_pad_rows(x, start, seq_len):
     blocks load unspecified garbage (NaN in interpret mode), and a matmul
     against even a zeroed operand turns 0·NaN into NaN."""
     pos = start + lax.broadcasted_iota(jnp.int32, x.shape, 0)
-    return jnp.where(pos < seq_len, x, 0.0)
+    return jnp.where(pos < seq_len, x, jnp.zeros_like(x))
 
 
 def _recompute_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk, *,
                     scale, causal, q_start, k_start, seq_len):
     """Shared bwd math: rebuild P = exp(S − LSE) for one (q, k) block pair
-    and form dS = P ∘ (dO·Vᵀ − Δ)·scale. lse_blk/delta_blk are [bq, 1]
-    column statistics. Returns (p, ds), both [bq, bk] fp32, zero on masked
-    (padded / acausal) positions."""
+    and form dS = P ∘ (dO·Vᵀ − Δ)·scale. Blocks stay in their input dtype
+    for the dots (MXU fast path); accumulation is fp32. lse_blk/delta_blk
+    are [bq, 1] column statistics. Returns (p, ds), both [bq, bk] fp32,
+    zero on masked (padded / acausal) positions."""
     s_blk = jax.lax.dot_general(
-        q_blk * scale, k_blk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                # [bq, bk]
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [bq, bk]
     shape = s_blk.shape
     q_pos = q_start + lax.broadcasted_iota(jnp.int32, shape, 0)
     k_pos = k_start + lax.broadcasted_iota(jnp.int32, shape, 1)
@@ -195,18 +201,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = _zero_pad_rows(q_ref[0].astype(jnp.float32), q_start, seq_len)
-        k = _zero_pad_rows(k_ref[0].astype(jnp.float32), k_start, seq_len)
-        v = _zero_pad_rows(v_ref[0].astype(jnp.float32), k_start, seq_len)
-        do = _zero_pad_rows(do_ref[0].astype(jnp.float32), q_start, seq_len)
+        q = _zero_pad_rows(q_ref[0], q_start, seq_len)
+        k = _zero_pad_rows(k_ref[0], k_start, seq_len)
+        v = _zero_pad_rows(v_ref[0], k_start, seq_len)
+        do = _zero_pad_rows(do_ref[0], q_start, seq_len)
         p, ds = _recompute_p_ds(
             q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
             causal=causal, q_start=q_start, k_start=k_start, seq_len=seq_len)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # pᵀ·dO [bk, d]
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # dsᵀ·q [bk, d]
 
     @pl.when(qi == num_q_blocks - 1)
@@ -233,15 +239,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = _zero_pad_rows(q_ref[0].astype(jnp.float32), q_start, seq_len)
-        k = _zero_pad_rows(k_ref[0].astype(jnp.float32), k_start, seq_len)
-        v = _zero_pad_rows(v_ref[0].astype(jnp.float32), k_start, seq_len)
-        do = _zero_pad_rows(do_ref[0].astype(jnp.float32), q_start, seq_len)
+        q = _zero_pad_rows(q_ref[0], q_start, seq_len)
+        k = _zero_pad_rows(k_ref[0], k_start, seq_len)
+        v = _zero_pad_rows(v_ref[0], k_start, seq_len)
+        do = _zero_pad_rows(do_ref[0], q_start, seq_len)
         _, ds = _recompute_p_ds(
             q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
             causal=causal, q_start=q_start, k_start=k_start, seq_len=seq_len)
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # ds·k [bq, d]
 
     @pl.when(ki == num_k_blocks - 1)
@@ -329,6 +335,12 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
                           interpret=interpret)
+    # Named so remat policies can keep the kernel's residuals: without
+    # these, `jax.checkpoint` re-runs the forward kernel during backward
+    # just to regenerate (out, lse) — a full extra attention pass per layer
+    # (models/transformer.py checkpoint_policy saves both names).
+    out = jax.ad_checkpoint.checkpoint_name(out, "attn_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 

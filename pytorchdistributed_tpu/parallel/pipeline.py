@@ -50,6 +50,7 @@ def gpipe_spmd(
     num_microbatches: int,
     mesh=None,
     remat: bool = True,
+    remat_policy: str = "full",
 ):
     """Run ``stage_apply(params_for_my_stage, h) -> h`` as a GPipe pipeline
     over the "pipe" mesh axis.
@@ -76,7 +77,11 @@ def gpipe_spmd(
             f"stage_params leading dims {leading} must equal the mesh's "
             f"pipe axis size {n_stages}")
     if remat:
-        stage_apply = jax.checkpoint(stage_apply)
+        from pytorchdistributed_tpu.models.transformer import (
+            checkpoint_policy,
+        )
+        stage_apply = jax.checkpoint(
+            stage_apply, policy=checkpoint_policy(remat_policy))
 
     param_spec = jax.tree.map(lambda _: P(Axis.PIPE), stage_params)
 

@@ -41,11 +41,10 @@ def _spawn_group(argv, nproc: int, port: int,
             "MASTER_PORT": str(port),
         })
         if devices_per_proc is not None:
+            from pytorchdistributed_tpu.runtime.launch import sim_device_flags
             env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                f"{env.get('XLA_FLAGS', '')} "
-                f"--xla_force_host_platform_device_count={devices_per_proc}"
-            ).strip()
+            env["XLA_FLAGS"] = sim_device_flags(
+                env.get("XLA_FLAGS", ""), devices_per_proc)
         procs.append(subprocess.Popen([sys.executable] + argv, env=env))
     return procs
 

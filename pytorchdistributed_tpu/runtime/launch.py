@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import re
 import socket
+import time
 from typing import Callable, Sequence
 
 
@@ -31,6 +33,16 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+def sim_device_flags(inherited: str, devices_per_proc: int) -> str:
+    """XLA_FLAGS for a CPU-sim worker: strip any pre-existing
+    device-count flag first (e.g. from a test/CI env), so the result holds
+    exactly one — relying on XLA's last-flag-wins is brittle."""
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   inherited)
+    return (f"{flags} --xla_force_host_platform_device_count="
+            f"{devices_per_proc}").strip()
 
 
 def _worker_env(rank: int, world_size: int, port: int,
@@ -45,10 +57,8 @@ def _worker_env(rank: int, world_size: int, port: int,
     if devices_per_proc is not None:
         # CPU-sim: each process gets its own simulated chips
         env["JAX_PLATFORMS"] = "cpu"
-        flags = os.environ.get("XLA_FLAGS", "")
-        env["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{devices_per_proc}").strip()
+        env["XLA_FLAGS"] = sim_device_flags(
+            os.environ.get("XLA_FLAGS", ""), devices_per_proc)
     return env
 
 
@@ -83,19 +93,31 @@ def launch(
     ]
     for p in procs:
         p.start()
+    # Poll ALL children (like run.py's agent) rather than join()ing them in
+    # order: a sequential join can hang forever when a later rank crashes
+    # while an earlier one blocks in a collective waiting for it.
+    deadline = None if timeout is None else time.monotonic() + timeout
     failed = None
     try:
-        for rank, p in enumerate(procs):
-            p.join(timeout)
-            if p.exitcode is None:
-                failed = failed or (rank, "timeout")
-            elif p.exitcode != 0:
-                failed = failed or (rank, f"exit code {p.exitcode}")
+        while failed is None:
+            codes = {rank: p.exitcode for rank, p in enumerate(procs)}
+            for rank, code in codes.items():
+                if code not in (None, 0):
+                    failed = (rank, f"exit code {code}")
+                    break
+            else:
+                if all(c == 0 for c in codes.values()):
+                    return
+                if deadline is not None and time.monotonic() > deadline:
+                    rank = next(r for r, c in codes.items() if c is None)
+                    failed = (rank, "timeout")
+                    break
+                time.sleep(0.05)
     finally:
-        if failed:
-            for p in procs:
-                if p.is_alive():
-                    p.terminate()
-    if failed:
-        raise RuntimeError(
-            f"rank {failed[0]} failed ({failed[1]}); terminated the rest")
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(10)
+    raise RuntimeError(
+        f"rank {failed[0]} failed ({failed[1]}); terminated the rest")

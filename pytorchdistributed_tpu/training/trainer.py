@@ -18,6 +18,7 @@ SPMD train step:
 
 from __future__ import annotations
 
+import json
 import time
 from functools import partial
 from typing import Any, Callable
@@ -88,6 +89,7 @@ class Trainer:
                 save_interval_steps=max(checkpoint_every_steps, 1))
         self.logger = MetricLogger()
         self._loss_fn = loss_fn
+        self._steps_per_epoch: int | None = None
         self.state: TrainState | None = None
         self.state_shardings = None
         self._step_fn = None
@@ -218,6 +220,7 @@ class Trainer:
         extra-batch-fetch wart (SURVEY.md §3.1). ``skip_steps`` fast-forwards
         past batches a resumed mid-epoch checkpoint already trained on."""
         loader.set_epoch(epoch)
+        self._steps_per_epoch = len(loader)
         if dist.is_main_process():
             self.logger.info(
                 f"epoch {epoch} | steps {len(loader)} | "
@@ -242,11 +245,18 @@ class Trainer:
 
     def _save_checkpoint(self, *, force: bool = False) -> None:
         """Save unless this step is already on disk (an epoch-end save can
-        land on the same step as the last interval save)."""
+        land on the same step as the last interval save). A JSON sidecar
+        records steps_per_epoch so resume can detect a changed loader
+        geometry (different batch size / replica count) instead of silently
+        skipping the wrong number of batches."""
         step = int(self.state.step)
         if step in self.checkpoint.all_steps():
             return
-        self.checkpoint.save(step, self.state, force=force)
+        if self.checkpoint.save(step, self.state, force=force) \
+                and self._steps_per_epoch and dist.is_main_process():
+            meta = {"steps_per_epoch": self._steps_per_epoch}
+            (self.checkpoint.directory / f"trainer_meta_{step}.json"
+             ).write_text(json.dumps(meta))
 
     def fit(self, loader, max_epochs: int, *,
             resume: bool = False) -> dict[str, float]:
@@ -255,9 +265,19 @@ class Trainer:
         every epoch end saves the sharded state async, and ``resume=True``
         continues from the latest step."""
         start_epoch, skip = 0, 0
-        if resume and self.checkpoint is not None \
-                and self.checkpoint.latest_step() is not None:
-            start_epoch, skip = self._resume(loader)
+        if resume:
+            if self.checkpoint is None:
+                raise ValueError(
+                    "fit(resume=True) needs a checkpoint_dir — none is "
+                    "configured, so there is nothing to resume from")
+            if self.checkpoint.latest_step() is None:
+                # Empty (or typo'd) directory: surface it loudly instead of
+                # silently training from scratch.
+                self.logger.info(
+                    f"WARNING: resume=True but no checkpoint under "
+                    f"{self.checkpoint.directory}; training from scratch")
+            else:
+                start_epoch, skip = self._resume(loader)
         metrics = {}
         for epoch in range(start_epoch, max_epochs):
             t0 = time.perf_counter()
@@ -286,6 +306,17 @@ class Trainer:
         if self.state is None:
             loader.set_epoch(0)
             self.init(next(iter(loader)))
+        step = self.checkpoint.latest_step()
+        meta_path = self.checkpoint.directory / f"trainer_meta_{step}.json"
+        if meta_path.exists():
+            saved = json.loads(meta_path.read_text()).get("steps_per_epoch")
+            if saved and saved != len(loader):
+                raise ValueError(
+                    f"checkpoint at step {step} was written with "
+                    f"steps_per_epoch={saved} but the current loader has "
+                    f"{len(loader)} — resuming would skip the wrong batches "
+                    f"or retrain duplicates; use the same batch size and "
+                    f"replica count as the saving run")
         self.state = self.checkpoint.restore(
             abstract_state_like(self.state, self.state_shardings))
         step = int(self.state.step)

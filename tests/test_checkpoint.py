@@ -98,6 +98,33 @@ def test_epoch_end_save_collides_with_interval_save(tmp_path):
     assert tr.checkpoint.latest_step() == 4
 
 
+def test_resume_without_checkpoint_dir_raises():
+    from pytorchdistributed_tpu.data import DataLoader, SyntheticTokenDataset
+
+    ds = SyntheticTokenDataset(size=16, seq_len=32, vocab_size=128, seed=0)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+    tr = _trainer()  # no checkpoint_dir
+    with pytest.raises(ValueError, match="resume"):
+        tr.fit(loader, 1, resume=True)
+
+
+def test_resume_with_changed_loader_geometry_raises(tmp_path):
+    """Resuming with a different batch size than the saving run must fail
+    loudly: (epoch, skip) is derived from steps-per-epoch, so a silent
+    mismatch would skip the wrong batches or retrain duplicates."""
+    from pytorchdistributed_tpu.data import DataLoader, SyntheticTokenDataset
+
+    ds = SyntheticTokenDataset(size=64, seq_len=32, vocab_size=128, seed=0)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+    tr = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    tr.fit(loader, 1)
+
+    other = DataLoader(ds, batch_size=16, num_replicas=1, rank=0, seed=0)
+    resumed = _trainer(checkpoint_dir=str(tmp_path / "ck"))
+    with pytest.raises(ValueError, match="steps_per_epoch"):
+        resumed.fit(other, 2, resume=True)
+
+
 def test_mid_epoch_resume_no_duplicate_batches(tmp_path):
     """Regression: resuming from a mid-epoch checkpoint must skip the
     already-trained prefix of that epoch (same final step and loss as an

@@ -38,6 +38,13 @@ def _failing_worker(rank):
         raise SystemExit(3)
 
 
+def _hang_or_fail_worker(rank):
+    if rank == 1:
+        raise SystemExit(5)
+    import time
+    time.sleep(600)  # rank 0 blocks (e.g. in a collective) forever
+
+
 def test_spawn_style_collective():
     """The mp.spawn path (reference ddp_gpus.py:98): 2 processes rendezvous
     via the env contract and complete a cross-process collective."""
@@ -47,6 +54,29 @@ def test_spawn_style_collective():
 def test_spawn_style_failure_propagates():
     with pytest.raises(RuntimeError, match="rank 1 failed"):
         launch(_failing_worker, 2, devices_per_proc=1, timeout=60)
+
+
+def test_spawn_style_fail_fast_with_blocked_earlier_rank():
+    """A later rank's crash must tear the group down even while an earlier
+    rank is blocked (the sequential-join hang: rank 0 stuck in a collective
+    waiting for dead rank 1). Must fail in seconds, not at rank 0's
+    600s sleep."""
+    import time
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="rank 1 failed"):
+        launch(_hang_or_fail_worker, 2, devices_per_proc=1, timeout=None)
+    assert time.monotonic() - t0 < 60
+
+
+def test_sim_device_flags_deduplicated():
+    """Inherited XLA_FLAGS with a device count must be replaced, not
+    appended (last-flag-wins is brittle)."""
+    from pytorchdistributed_tpu.runtime.launch import sim_device_flags
+    out = sim_device_flags(
+        "--foo=1 --xla_force_host_platform_device_count=8 --bar=2", 4)
+    assert out.count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=4" in out
+    assert "--foo=1" in out and "--bar=2" in out
 
 
 def test_torchrun_style_cli(tmp_path):

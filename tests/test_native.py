@@ -25,6 +25,18 @@ def test_gather_bounds_checked():
         _native.gather(src, np.array([-1]))
 
 
+def test_gather_bounds_identical_on_fallback_path():
+    """Semantics must not depend on build state: the numpy fallback
+    (non-contiguous src) rejects negative/oob indices exactly like the
+    native path, instead of numpy's silent negative wrapping."""
+    src = np.asfortranarray(np.zeros((4, 3), np.float32))
+    assert not src.flags.c_contiguous
+    with pytest.raises(IndexError):
+        _native.gather(src, np.array([-1]))
+    with pytest.raises(IndexError):
+        _native.gather(src, np.array([4]))
+
+
 def test_gather_non_contiguous_falls_back():
     src = np.asfortranarray(np.arange(24, dtype=np.float32).reshape(4, 6))
     idx = np.array([2, 0])
