@@ -208,16 +208,19 @@ def bench_sweep() -> dict:
         "targets": rng.integers(0, 512, (32, 128)).astype(np.int32),
     }
     results = {}
-    for m in [1, 2, 4, 8, 16, 32]:
-        model = GPT2(gpt2_config(
-            "test", num_layers=4, vocab_size=512,
-            pipeline_stages=2, pipeline_microbatches=m))
-        tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
-                     mesh=create_mesh(pipe=2), strategy="dp",
-                     log_every=10**9)
-        results[m] = _time_steps(tr, batch, warmup=1, steps=5)
+    for sched in ("gpipe", "1f1b"):
+        for m in [1, 2, 4, 8, 16, 32]:
+            if sched == "1f1b" and m == 1:
+                continue  # degenerate: no overlap to schedule
+            model = GPT2(gpt2_config(
+                "test", num_layers=4, vocab_size=512, pipeline_stages=2,
+                pipeline_microbatches=m, pp_schedule=sched))
+            tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                         mesh=create_mesh(pipe=2), strategy="dp",
+                         log_every=10**9)
+            results[(sched, m)] = _time_steps(tr, batch, warmup=1, steps=5)
     best = min(results, key=results.get)
-    print(f"sweep step seconds: {results} (best microbatches={best})",
+    print(f"sweep step seconds: {results} (best schedule,microbatches={best})",
           file=sys.stderr, flush=True)
     return {"metric": "pp_sweep_best_tokens_per_s",
             "value": round(32 * 128 / results[best], 1), "unit": "tokens/s"}

@@ -34,6 +34,9 @@ class ExperimentConfig:
     seq: int = 1
     num_slices: int = 1
     pipeline_microbatches: int = 1
+    pp_schedule: str = "gpipe"     # gpipe | 1f1b (transformer models)
+    expert: int = 1                # mesh axis for expert parallelism
+    moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
     # precision
     bf16: bool = True
     # training
@@ -41,6 +44,13 @@ class ExperimentConfig:
     batch_size: int = 32           # per-process
     learning_rate: float = 1e-3
     optimizer: str = "adamw"       # adamw | sgd
+    # LR schedule: peak = learning_rate, linear warmup over warmup_steps,
+    # then constant / cosine / linear decay to lr_end over decay_steps.
+    lr_schedule: str = "constant"  # constant | cosine | linear
+    warmup_steps: int = 0
+    decay_steps: int = 10_000      # decay horizon (cosine/linear)
+    lr_end: float = 0.0
+    grad_clip_norm: float = 0.0    # clip_by_global_norm; 0 = off
     seed: int = 0
     # data shapes (synthetic datasets)
     dataset_size: int = 2048
@@ -53,6 +63,8 @@ class ExperimentConfig:
     checkpoint_every_steps: int = 0
     resume: bool = False
     log_every: int = 10
+    profile_dir: str = ""          # capture a jax.profiler trace here
+    watchdog: bool = True          # NaN/Inf watchdog at log cadence
 
 
 # The five BASELINE.json benchmark configs, smallest to largest.
@@ -157,14 +169,19 @@ def build(cfg: ExperimentConfig):
     from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh
     from pytorchdistributed_tpu.training import (
         cross_entropy_loss,
+        moe_token_cross_entropy_loss,
         mse_loss,
         token_cross_entropy_loss,
     )
 
+    if cfg.moe_experts > 0:
+        token_cross_entropy_loss = moe_token_cross_entropy_loss
+
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
     tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
                pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
-               pipeline_microbatches=cfg.pipeline_microbatches)
+               pipeline_microbatches=cfg.pipeline_microbatches,
+               pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts)
 
     if cfg.model == "gpt2":
         model = models.GPT2(models.gpt2_config(
@@ -201,8 +218,8 @@ def build(cfg: ExperimentConfig):
         raise ValueError(f"unknown model {cfg.model!r}")
 
     mesh = create_mesh(MeshConfig(
-        data=cfg.data, fsdp=cfg.fsdp, tensor=cfg.tensor, pipe=cfg.pipe,
-        seq=cfg.seq, num_slices=cfg.num_slices))
+        data=cfg.data, fsdp=cfg.fsdp, expert=cfg.expert, tensor=cfg.tensor,
+        pipe=cfg.pipe, seq=cfg.seq, num_slices=cfg.num_slices))
     if cfg.optimizer == "adamw":
         opt = optax.adamw(cfg.learning_rate)
     elif cfg.optimizer == "sgd":
@@ -226,5 +243,7 @@ def make_trainer(cfg: ExperimentConfig):
         log_every=cfg.log_every,
         checkpoint_dir=cfg.checkpoint_dir or None,
         checkpoint_every_steps=cfg.checkpoint_every_steps,
+        watchdog=cfg.watchdog,
+        profile_dir=cfg.profile_dir or None,
     )
     return trainer, loader

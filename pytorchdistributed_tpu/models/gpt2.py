@@ -10,10 +10,12 @@ replacement, built on the shared TransformerStack so every parallel strategy
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
+    TransformerBlock,
     TransformerConfig,
     TransformerStack,
     _layer_norm,
@@ -43,6 +45,80 @@ class GPT2(nn.Module):
                 name="lm_head",
             )(x)
         return logits.astype(jnp.float32)
+
+    @nn.nowrap
+    def pipeline_parts(self):
+        """Decomposition for the 1F1B fused train step
+        (parallel/pipeline.py `one_f_one_b`; reference schedule spec
+        03_model_parallel.ipynb:668-697): pre = Embedder, stages = layer
+        groups of the scanned stack, head = ln_f + (tied) logit projection +
+        token cross-entropy. The tied embedding appears in both pre and head;
+        `merge_grads` sums the two contributions."""
+        from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
+
+        cfg = self.cfg
+        p = cfg.pipeline_stages
+        if cfg.num_layers % p:
+            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                             f"pipeline_stages {p}")
+        if not cfg.scan_layers:
+            raise ValueError("pipeline_parts requires scan_layers=True")
+        block = TransformerBlock(cfg, deterministic=True)
+
+        def split(params):
+            pp = params["params"]
+            stage = jax.tree.map(
+                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
+                pp["h"]["block"])
+            head = {"ln_f": pp["ln_f"]}
+            head["proj"] = (pp["embed"]["tok"]["embedding"]
+                            if cfg.tie_embeddings
+                            else pp["lm_head"]["kernel"])
+            return pp["embed"], stage, head
+
+        def pre_apply(pre, tokens):
+            return Embedder(cfg).apply({"params": pre}, tokens)
+
+        def stage_apply(stage_leaf, h):
+            def layer(h, lp):
+                return block.apply({"params": lp}, h), None
+
+            h, _ = jax.lax.scan(layer, h, stage_leaf)
+            return h
+
+        def head_loss(head, h, targets):
+            x = _layer_norm(cfg, None).apply({"params": head["ln_f"]}, h)
+            proj = head["proj"].astype(cfg.dtype)
+            logits = (x.astype(cfg.dtype) @ proj.T if cfg.tie_embeddings
+                      else x.astype(cfg.dtype) @ proj).astype(jnp.float32)
+            # Gather-free (vocab-parallel) cross-entropy: under TP the vocab
+            # dim is tensor-sharded, and a take-along-axis gather on a
+            # sharded dim inside the manual-pipe shard_map crashes XLA's
+            # SPMD partitioner — the one-hot contraction partitions cleanly
+            # (Megatron's vocab-parallel CE shape) and XLA reduces it to the
+            # same FLOPs.
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            true = jnp.einsum(
+                "bsv,bsv->bs", logits,
+                jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32))
+            return (lse - true).mean()
+
+        def merge_grads(pre_g, stage_g, head_g):
+            blocks = jax.tree.map(
+                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            tree = {"embed": pre_g, "h": {"block": blocks},
+                    "ln_f": head_g["ln_f"]}
+            if cfg.tie_embeddings:
+                tok = tree["embed"]["tok"]
+                tree["embed"] = dict(tree["embed"])
+                tree["embed"]["tok"] = {
+                    "embedding": tok["embedding"] + head_g["proj"]}
+            else:
+                tree["lm_head"] = {"kernel": head_g["proj"]}
+            return {"params": tree}
+
+        return PipelineParts(split, pre_apply, stage_apply, head_loss,
+                             merge_grads)
 
 
 def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:

@@ -1,0 +1,109 @@
+"""Mixture-of-Experts — Switch-style top-1 routing over the "expert" mesh
+axis (SURVEY.md §2c "EP", the optional strategy; the reference has no MoE
+content at all, so the design is TPU-first rather than a port).
+
+TPU-idiomatic expert parallelism is *not* a per-token gather/scatter loop:
+
+  * routing is computed densely (router logits → top-1 → one-hot dispatch
+    and combine tensors), so every shape is static and XLA can tile the
+    whole thing onto the MXU;
+  * dispatch/combine are einsums against a ``[tokens, experts, capacity]``
+    one-hot — when tokens are sharded over "data" and the expert dim of the
+    stacked expert MLPs over "expert" (rule table parallel/tp.py
+    ``Logical.EXPERT → Axis.EXPERT``), XLA lowers these einsums to the
+    all_to_all exchange that GPU frameworks hand-write;
+  * each expert processes a fixed ``capacity = ceil(cf · tokens/experts)``
+    slots; overflow tokens skip the expert and ride the residual connection
+    (standard Switch behavior) — static shapes, no data-dependent control
+    flow inside jit;
+  * the Switch load-balancing auxiliary loss is sown into the "losses"
+    collection; `training.losses.moe_aux_loss` collects it.
+
+Reference for the pattern (PAPERS.md): Switch Transformer (Fedus et al.),
+as realized in public JAX codebases (flaxformer/t5x-style dense dispatch).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.parallel.tp import Logical
+
+
+class SwitchMoE(nn.Module):
+    """Drop-in MLP replacement: top-1 routed expert FFNs.
+
+    Call shape ``[batch, seq, embed] -> [batch, seq, embed]``. Expert
+    kernels are stacked ``[experts, ...]`` with logical axis
+    ``Logical.EXPERT`` so the "tp" rule table shards them over the "expert"
+    mesh axis.
+    """
+
+    cfg: "TransformerConfig"  # noqa: F821 — transformer.py's config
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e, d, f = cfg.moe_experts, cfg.embed_dim, cfg.ffn_dim
+        b, s, _ = x.shape
+        g = b * s  # token count
+        capacity = max(1, math.ceil(cfg.moe_capacity_factor * g / e))
+
+        # -- router (fp32 for a stable softmax/argmax) -------------------
+        router_kernel = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                (Logical.EMBED, Logical.EXPERT)),
+            (d, e), jnp.float32)
+        tokens = x.reshape(g, d)
+        logits = tokens.astype(jnp.float32) @ router_kernel     # [g, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)                 # [g]
+        gate = jnp.max(probs, axis=-1)                          # [g]
+        expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+
+        # Switch aux loss: e · Σ_e (token fraction to e) · (mean prob of e).
+        # Minimized (=1) at uniform routing; sown for the loss fn to add.
+        frac = expert_onehot.mean(0)
+        aux = e * jnp.sum(frac * probs.mean(0))
+        self.sow("losses", "moe_aux", aux)
+
+        # -- dispatch: each token takes the next free slot of its expert --
+        pos = jnp.sum(jnp.cumsum(expert_onehot, axis=0) * expert_onehot,
+                      axis=-1).astype(jnp.int32) - 1            # [g]
+        kept = pos < capacity                                   # overflow→residual
+        dispatch = (expert_onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
+            pos, capacity, dtype=jnp.float32)[:, None, :]       # [g, e, c]
+        combine = dispatch * gate[:, None, None]
+
+        # -- expert FFNs on [e, c, d] slots ------------------------------
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                (Logical.EXPERT, Logical.EMBED, Logical.MLP)),
+            (e, d, f), cfg.param_dtype)
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                (Logical.EXPERT, Logical.MLP, Logical.EMBED)),
+            (e, f, d), cfg.param_dtype)
+        slots = jnp.einsum("gec,gd->ecd", dispatch.astype(cfg.dtype),
+                           tokens.astype(cfg.dtype))
+        slots = nn.with_logical_constraint(
+            slots, (Logical.EXPERT, None, Logical.EMBED))
+        h = nn.gelu(jnp.einsum("ecd,edf->ecf", slots, wi.astype(cfg.dtype)))
+        h = nn.with_logical_constraint(h, (Logical.EXPERT, None, Logical.MLP))
+        out_slots = jnp.einsum("ecf,efd->ecd", h, wo.astype(cfg.dtype))
+        out = jnp.einsum("gec,ecd->gd", combine.astype(cfg.dtype), out_slots)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(
+                out, deterministic=self.deterministic)
+        return out.reshape(b, s, d)

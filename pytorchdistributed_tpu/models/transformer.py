@@ -61,9 +61,21 @@ class TransformerConfig:
     remat_policy: str = "dots"          # full | dots | dots_all
     tie_embeddings: bool = True
     # Pipeline parallelism (parallel/pipeline.py): >1 runs the stack as a
-    # GPipe pipeline over the "pipe" mesh axis with this many stages.
+    # pipeline over the "pipe" mesh axis with this many stages.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 1
+    # "gpipe": forward pipeline, backward by AD — O(M) in-flight residuals.
+    # "1f1b": fused train-step schedule (PipeDream-flush) — residuals bounded
+    # by stage count; training only, selected by the Trainer's step builder
+    # (the pure forward path always pipelines GPipe-style — schedules only
+    # differ in where the backward interleaves).
+    pp_schedule: str = "gpipe"
+    # Mixture-of-Experts (models/moe.py): >0 replaces every block's MLP with
+    # a Switch top-1 routed expert FFN bank, shardable over the "expert"
+    # mesh axis. Use losses that add the sown load-balance aux term
+    # (training.losses.moe_aux_loss).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -246,7 +258,12 @@ class TransformerBlock(nn.Module):
         h = _layer_norm(cfg, "ln1")(x).astype(cfg.dtype)
         x = x + SelfAttention(cfg, self.deterministic, name="attn")(h)
         h = _layer_norm(cfg, "ln2")(x).astype(cfg.dtype)
-        x = x + MlpBlock(cfg, self.deterministic, name="mlp")(h)
+        if cfg.moe_experts > 0:
+            from pytorchdistributed_tpu.models.moe import SwitchMoE
+
+            x = x + SwitchMoE(cfg, self.deterministic, name="moe")(h)
+        else:
+            x = x + MlpBlock(cfg, self.deterministic, name="mlp")(h)
         return nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 
@@ -274,7 +291,7 @@ class TransformerStack(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry), None),
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: Logical.STAGE},

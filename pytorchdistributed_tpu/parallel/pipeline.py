@@ -1,4 +1,5 @@
-"""Pipeline parallelism — GPipe schedule, SPMD-style (SURVEY.md §2c "PP").
+"""Pipeline parallelism — GPipe and 1F1B schedules, SPMD-style (SURVEY.md
+§2c "PP").
 
 The reference implements a manual 2-stage pipeline: split the batch into
 micro-batches and overlap stage-2 of split k with stage-1 of split k+1
@@ -27,10 +28,30 @@ all-gathers.
 
 Bubble fraction is (P-1)/(M+P-1), the GPipe figure; the micro-batch count M
 is the knob the reference sweeps in its split-size benchmark (:586-623).
+
+GPipe's weakness is memory: it runs all M forwards before the first
+backward, so every in-flight micro-batch holds residuals — O(M) activation
+slots per device (remat only trades which tensors, not how many
+micro-batches). The reference's cell 15 (03_model_parallel.ipynb:668-697)
+describes the fix: the 1F1B schedule starts a micro-batch's backward as soon
+as its forward clears the last stage, bounding in-flight activations by the
+*stage count*. `one_f_one_b` below implements it. One JAX-specific truth
+shapes the API: 1F1B interleaves backwards with forwards, so the loss
+cotangent must exist while forwards are still running — it cannot be a
+`custom_vjp` around a pure forward function. It is therefore a fused
+train-grads primitive (forward + loss + backward in one compiled loop)
+returning gradients directly, and the Trainer selects it as an alternative
+step builder (``pp_schedule="1f1b"``) rather than an alternative forward.
+PipeDream's weight stashing / vertical sync (:685-691) are deliberately NOT
+implemented: they exist to hide gradient staleness in an *asynchronous*
+pipeline, while this schedule is synchronous within one optimizer step — the
+flush variant (PipeDream-flush ≙ non-interleaved 1F1B, :697), which has no
+staleness to hide.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Callable
 
@@ -154,3 +175,230 @@ def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
     masked = jnp.where(my_stage == p - 1, outs, jnp.zeros_like(outs))
     outs = lax.psum(masked.astype(jnp.float32), Axis.PIPE).astype(outs.dtype)
     return outs.reshape(b, *outs.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush / non-interleaved) schedule
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineParts:
+    """A model's decomposition for the 1F1B fused train step (the analog of
+    the reference's manual seq1/seq2 stage split, 03_model_parallel.ipynb:
+    325-349, generalized to pre/stages/head):
+
+      * ``split(params) -> (pre, stage, head)`` param sub-trees — ``stage``
+        leaves stacked ``[P, ...]``;
+      * ``pre_apply(pre, batch_inputs) -> x``: everything before stage 0
+        (embeddings) — differentiated by AD outside the pipeline via the
+        ``dx`` that `one_f_one_b` returns;
+      * ``stage_apply(stage_leaf, h) -> h``: one pipeline stage;
+      * ``head_loss(head, h, targets) -> scalar fp32``: final projection +
+        loss, fused into the last stage;
+      * ``merge_grads(pre_g, stage_g, head_g)`` -> grads shaped like the full
+        param tree (summing any tied leaves, e.g. GPT-2's tied embedding).
+    """
+
+    split: Callable
+    pre_apply: Callable
+    stage_apply: Callable
+    head_loss: Callable
+    merge_grads: Callable
+
+
+def _require_pipe_mesh(mesh, who: str):
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.axis_names:
+            raise ValueError(
+                f"{who} needs a mesh: call under jax.set_mesh(mesh) or "
+                f"pass mesh=")
+    if Axis.PIPE not in mesh.axis_names:
+        raise ValueError(
+            f"{who} needs a '{Axis.PIPE}' mesh axis; got axes "
+            f"{mesh.axis_names} (build the mesh with runtime.mesh.create_mesh)")
+    return mesh
+
+
+def one_f_one_b(
+    stage_apply: Callable,
+    stage_params,
+    head_loss: Callable,
+    head_params,
+    x: jax.Array,
+    targets,
+    *,
+    num_microbatches: int,
+    mesh=None,
+):
+    """Non-interleaved 1F1B pipeline **train-grads** primitive (the
+    reference's PipeDream-flush schedule, 03_model_parallel.ipynb:668-697).
+
+    One compiled loop runs T = M + 2P - 2 pair-ticks; at each tick every
+    device executes one forward slot and one backward slot. Micro-batch k's
+    forward reaches stage s at tick k+s; its backward reaches stage s at
+    tick k + 2P-2-s — so at the last stage the backward starts the same tick
+    the forward finishes (the "one forward, one backward" steady state), and
+    a stage holds at most 2(P-s)-1 in-flight residuals. Residuals live in a
+    ring buffer of 2P-1 micro-batch slots — bounded by the *stage count* —
+    versus GPipe's M+P-1 (AD of the forward scan saves one per tick). The
+    backward slot rebuilds its VJP by re-running the stage forward from the
+    stored stage *input* (activation recomputation, reference :637-643), so
+    per-micro-batch compute is 2F+B — identical to GPipe with remat=True.
+
+    Args:
+      stage_apply: ``(stage_params_leaf, h) -> h`` — one stage's forward.
+      stage_params: pytree, leaves ``[P, ...]`` stage-stacked (sharded over
+        the "pipe" mesh axis).
+      head_loss: ``(head_params, h, targets_mb) -> scalar fp32 loss`` (mean
+        over the micro-batch) — the last stage's projection + loss, fused
+        into the pipeline so its cotangent is born where the backward starts.
+      head_params: pytree (replicated over "pipe").
+      x: ``[batch, ...]`` activations entering stage 0 (e.g. embedded
+        tokens). Other mesh axes (data/fsdp/tensor/seq) stay automatic.
+      targets: ``[batch, ...]`` labels consumed by ``head_loss``.
+
+    Returns:
+      ``(loss, stage_grads, head_grads, dx)``: mean loss over micro-batches;
+      grads for stage_params (``[P, ...]`` stacked) and head_params
+      (replicated); and ``dx``, the loss cotangent w.r.t. ``x`` — feed it to
+      the VJP of whatever produced ``x`` (embedding) to complete the step.
+    """
+    mesh = _require_pipe_mesh(mesh, "one_f_one_b")
+    n_stages = mesh.shape[Axis.PIPE]
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading dims {leading} must equal the mesh's "
+            f"pipe axis size {n_stages}")
+    if x.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches "
+            f"{num_microbatches}")
+
+    param_spec = jax.tree.map(lambda _: P(Axis.PIPE), stage_params)
+    rep = jax.tree.map(lambda _: P(), head_params)
+
+    fn = jax.shard_map(
+        functools.partial(_one_f_one_b_local, stage_apply, head_loss,
+                          m=num_microbatches, p=n_stages),
+        mesh=mesh,
+        axis_names={Axis.PIPE},
+        in_specs=(param_spec, rep, P(), P()),
+        out_specs=(P(), param_spec, rep, P()),
+    )
+    return fn(stage_params, head_params, x, targets)
+
+
+def _to_varying(v):
+    """Mark a pipe-invariant value varying. Sub-fp32 floats ride the wire as
+    fp32: a sub-fp32 pcast lowers to a copy-reduction all-reduce that
+    XLA:CPU's AllReducePromotion pass crashes cloning (TPU would silently
+    promote it anyway)."""
+    if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != jnp.float32:
+        return lax.pcast(v.astype(jnp.float32), Axis.PIPE,
+                         to="varying").astype(v.dtype)
+    return lax.pcast(v, Axis.PIPE, to="varying")
+
+
+def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
+                       x, targets, *, m: int, p: int):
+    """Per-device 1F1B body (inside shard_map, "pipe" axis manual)."""
+    s = lax.axis_index(Axis.PIPE)
+    r = 2 * p - 1  # residual ring-buffer slots: ≥ max in-flight (2P-2) + 1
+    stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
+    # Every device takes the head vjp (masked out except at the last stage).
+    # head_params must be explicitly varying first: a vjp w.r.t. a
+    # pipe-INVARIANT input transposes the implicit invariant→varying
+    # broadcast into a psum over "pipe", silently summing every stage's
+    # masked-out garbage head-gradient into the real one.
+    head_params = jax.tree.map(_to_varying, head_params)
+
+    b = x.shape[0]
+    mb = b // m
+    x_mb = _to_varying(x.reshape(m, mb, *x.shape[1:]))
+    t_mb = jax.tree.map(
+        lambda t: _to_varying(t.reshape(m, b // m, *t.shape[1:])), targets)
+
+    def vz(shape, dtype):
+        return _to_varying(jnp.zeros(shape, dtype))
+
+    act_shape, act_dtype = x_mb.shape[1:], x.dtype
+    carry0 = (
+        vz(act_shape, act_dtype),                       # f_recv
+        vz(act_shape, act_dtype),                       # b_recv
+        vz((r,) + act_shape, act_dtype),                # resid ring buffer
+        jax.tree.map(lambda a: vz(a.shape, a.dtype), stage_params),
+        jax.tree.map(lambda a: vz(a.shape, a.dtype), head_params),
+        vz((), jnp.float32),                            # loss accumulator
+        vz(x_mb.shape, act_dtype),                      # dx per micro-batch
+    )
+    fwd = [(i, (i + 1) % p) for i in range(p)]
+    bwd = [(i, (i - 1) % p) for i in range(p)]
+
+    def masked_add(acc, g, active):
+        return jax.tree.map(
+            lambda a, d: a + jnp.where(active, d, jnp.zeros_like(d)), acc, g)
+
+    def tick(carry, u):
+        f_recv, b_recv, resid, stage_g, head_g, loss_acc, dx = carry
+
+        # ---- forward slot: micro-batch k_f = u - s ----
+        k_f = u - s
+        active_f = (k_f >= 0) & (k_f < m)
+        kf = jnp.clip(k_f, 0, m - 1)
+        h_in = jnp.where(s == 0, x_mb[kf], f_recv)
+        h_out = stage_apply(stage_params, h_in)
+        resid = jnp.where(
+            active_f,
+            lax.dynamic_update_index_in_dim(resid, h_in, kf % r, 0), resid)
+        # Last stage: fuse projection+loss and bear the cotangent that seeds
+        # this same tick's backward slot (at stage P-1, k_b == k_f).
+        mb_targets = jax.tree.map(lambda t: t[kf], t_mb)
+        loss_k, head_vjp = jax.vjp(
+            lambda hp, h: head_loss(hp, h, mb_targets), head_params, h_out)
+        # Global loss = (1/M)·Σ per-micro-batch means, so each micro-batch's
+        # cotangent is 1/M.
+        dhead, dh_loss = head_vjp(_to_varying(jnp.full((), 1 / m,
+                                                       loss_k.dtype)))
+        at_last = active_f & (s == p - 1)
+        loss_acc = loss_acc + jnp.where(at_last, loss_k, 0.0)
+        head_g = masked_add(head_g, dhead, at_last)
+
+        # ---- backward slot: micro-batch k_b = u - (2P-2-s) ----
+        k_b = u - (2 * p - 2 - s)
+        active_b = (k_b >= 0) & (k_b < m)
+        kb = jnp.clip(k_b, 0, m - 1)
+        g_in = jnp.where(s == p - 1, dh_loss.astype(act_dtype), b_recv)
+        h_res = resid[kb % r]
+        # Recompute the stage forward from the stored input to rebuild the
+        # VJP — activation recomputation by construction.
+        _, stage_vjp = jax.vjp(stage_apply, stage_params, h_res)
+        dstage, dh_in = stage_vjp(g_in)
+        stage_g = masked_add(stage_g, dstage, active_b)
+        dx = jnp.where(
+            active_b & (s == 0),
+            lax.dynamic_update_index_in_dim(dx, dh_in, kb, 0), dx)
+
+        # ---- rotate: activations one hop forward, cotangents one back ----
+        f_recv = lax.ppermute(h_out, Axis.PIPE, fwd)
+        b_recv = lax.ppermute(dh_in, Axis.PIPE, bwd)
+        return (f_recv, b_recv, resid, stage_g, head_g, loss_acc, dx), None
+
+    carry, _ = lax.scan(tick, carry0, jnp.arange(m + 2 * p - 2))
+    _, _, _, stage_g, head_g, loss_acc, dx = carry
+
+    def replicate_from(acc, holder):
+        """psum the holder stage's accumulator to every device (fp32 wire:
+        see _to_varying)."""
+        def one(g):
+            g32 = jnp.where(holder, g, jnp.zeros_like(g)).astype(jnp.float32)
+            return lax.psum(g32, Axis.PIPE).astype(g.dtype)
+        return jax.tree.map(one, acc)
+
+    loss = lax.psum(jnp.where(s == p - 1, loss_acc, 0.0), Axis.PIPE) / m
+    head_g = replicate_from(head_g, s == p - 1)
+    dx = replicate_from(dx, s == 0)
+    stage_g = jax.tree.map(lambda g: g[None], stage_g)  # [1,...] -> P-stacked
+    return loss, stage_g, head_g, dx.reshape(b, *x.shape[1:])

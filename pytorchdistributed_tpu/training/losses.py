@@ -42,3 +42,29 @@ def token_cross_entropy_loss(model, params, batch, rng=None):
     else:
         loss = ce.mean()
     return loss, {"loss": loss}
+
+
+MOE_AUX_WEIGHT = 0.01  # Switch Transformer's load-balance coefficient
+
+
+def moe_token_cross_entropy_loss(model, params, batch, rng=None):
+    """`token_cross_entropy_loss` (same {tokens, targets, loss_mask?}
+    contract) + the Switch load-balance auxiliary loss sown by models/moe.py
+    (collection "losses"). The aux term (mean over layers, weight
+    `MOE_AUX_WEIGHT`) pushes the router toward uniform expert utilization;
+    without it top-1 routing collapses onto one expert."""
+    import jax
+
+    logits, mods = model.apply(params, batch["tokens"], mutable=["losses"])
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["targets"])
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        ce = jnp.where(mask, ce, 0.0)
+        ce = ce.sum() / jnp.maximum(mask.sum(), 1)
+    else:
+        ce = ce.mean()
+    sown = jax.tree.leaves(mods.get("losses", {}))
+    aux = (sum(jnp.mean(v) for v in sown) / max(len(sown), 1)) if sown else 0.0
+    loss = ce + MOE_AUX_WEIGHT * aux
+    return loss, {"loss": loss, "ce": ce, "moe_aux": jnp.float32(aux)}

@@ -37,6 +37,11 @@ from pytorchdistributed_tpu.runtime import dist
 from pytorchdistributed_tpu.data.loader import shard_batch
 from pytorchdistributed_tpu.runtime.mesh import batch_leaf_sharding, create_mesh
 from pytorchdistributed_tpu.training.logging import MetricLogger
+from pytorchdistributed_tpu.utils.guards import (
+    NaNWatchdog,
+    assert_replicas_consistent,
+)
+from pytorchdistributed_tpu.utils.metrics import ThroughputMeter
 
 
 class TrainState(struct.PyTreeNode):
@@ -68,6 +73,8 @@ class Trainer:
         log_every: int = 10,
         checkpoint_dir: str | None = None,
         checkpoint_every_steps: int = 0,
+        watchdog: bool = True,
+        profile_dir: str | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -90,6 +97,14 @@ class Trainer:
         self.logger = MetricLogger()
         self._loss_fn = loss_fn
         self._steps_per_epoch: int | None = None
+        # SURVEY.md §5 wiring: the watchdog checks metrics at log cadence
+        # (a float() on a device value blocks on the step, so an every-step
+        # check would serialize the hot loop and defeat prefetch overlap)
+        # and the full param tree every `state_every` checks.
+        self._watchdog = NaNWatchdog() if watchdog else None
+        self._meter = ThroughputMeter()
+        self.profile_dir = profile_dir
+        self._profiling = False
         self.state: TrainState | None = None
         self.state_shardings = None
         self._step_fn = None
@@ -134,6 +149,10 @@ class Trainer:
             params=abstract_params,
             opt_state=jax.eval_shape(self.optimizer.init, abstract_params),
         )
+        # Collective-mismatch guard (SURVEY.md §5) BEFORE the first compile:
+        # divergent structure across processes deadlocks the pod the way
+        # mismatched NCCL calls do; the digest allgather fails fast instead.
+        assert_replicas_consistent(abstract, name="abstract TrainState")
         param_sh = shardings_for_strategy(
             self.strategy, abstract_boxed, self.mesh
         )
@@ -159,7 +178,17 @@ class Trainer:
 
     # -- the jitted hot loop ----------------------------------------------
 
+    def _transformer_cfg(self):
+        """The model's TransformerConfig, unwrapping containers that nest it
+        (ViTConfig.transformer)."""
+        cfg = getattr(self.model, "cfg", None)
+        return getattr(cfg, "transformer", cfg)
+
     def _build_step(self):
+        cfg = self._transformer_cfg()
+        if (getattr(cfg, "pipeline_stages", 1) > 1
+                and getattr(cfg, "pp_schedule", "gpipe") == "1f1b"):
+            return self._build_1f1b_step()
         policy = self.precision
         loss_fn = self._loss_fn
         if self.remat:
@@ -202,6 +231,82 @@ class Trainer:
             donate_argnums=(0,),
         )
 
+    def _build_1f1b_step(self):
+        """Fused 1F1B pipeline train step (pp_schedule="1f1b").
+
+        1F1B interleaves each micro-batch's backward between later
+        micro-batches' forwards, so it cannot be expressed as a forward pass
+        plus AD — the whole step (forward + loss + backward) is one schedule
+        (parallel/pipeline.py `one_f_one_b`). The model supplies its
+        pre/stages/head decomposition via ``pipeline_parts()``; only the
+        pre-stage part (embeddings) is differentiated by AD, seeded with the
+        ``dx`` cotangent the pipeline returns. The optimizer update is
+        identical to the AD path's."""
+        from pytorchdistributed_tpu.parallel.pipeline import one_f_one_b
+
+        if not hasattr(self.model, "pipeline_parts"):
+            raise ValueError(
+                f"pp_schedule='1f1b' needs {type(self.model).__name__}"
+                f".pipeline_parts() (the pre/stages/head decomposition); "
+                f"use pp_schedule='gpipe' for models without one")
+        cfg = self._transformer_cfg()
+        if cfg.dropout_rate > 0:
+            raise NotImplementedError(
+                "dropout inside the 1f1b pipelined stack is not supported yet")
+        if getattr(cfg, "moe_experts", 0) > 0:
+            # The fused schedule runs block.apply without mutable
+            # collections, so the sown load-balance aux loss would be
+            # silently dropped — refuse rather than train a collapsing
+            # router.
+            raise NotImplementedError(
+                "moe_experts > 0 with pp_schedule='1f1b' is not supported "
+                "yet (the Switch aux loss cannot ride the fused pipeline); "
+                "use pp_schedule='gpipe'")
+        from pytorchdistributed_tpu.training.losses import (
+            token_cross_entropy_loss,
+        )
+        if self._loss_fn is not token_cross_entropy_loss:
+            # The fused step computes loss inside the pipeline's last stage
+            # (model.pipeline_parts().head_loss) — the Trainer-level loss_fn
+            # cannot be threaded through it.
+            self.logger.info(
+                "WARNING: pp_schedule='1f1b' uses the model's fused "
+                f"head_loss; the custom loss_fn "
+                f"{getattr(self._loss_fn, '__name__', self._loss_fn)!r} "
+                f"is ignored")
+        parts = self.model.pipeline_parts()
+        policy = self.precision
+
+        def step(state: TrainState, batch):
+            cparams = policy.cast_params_for_compute(state.params)
+            with nn.logical_axis_rules(self._rules):
+                pre_p, stage_p, head_p = parts.split(cparams)
+                x, pre_vjp = jax.vjp(
+                    lambda pp: parts.pre_apply(pp, *self._model_args(batch)),
+                    pre_p)
+                loss, stage_g, head_g, dx = one_f_one_b(
+                    parts.stage_apply, stage_p, parts.head_loss, head_p,
+                    x, batch["targets"],
+                    num_microbatches=cfg.pipeline_microbatches,
+                    mesh=self.mesh)
+                (pre_g,) = pre_vjp(dx)
+                grads = parts.merge_grads(pre_g, stage_g, head_g)
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), grads, state.params)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state)
+            return new_state, {"loss": loss.astype(jnp.float32)}
+
+        return jax.jit(
+            step,
+            in_shardings=(self.state_shardings, None),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
     def train_step(self, batch) -> dict[str, float]:
         """One optimizer step (the reference's ``_run_batch``)."""
         if self.state is None:
@@ -234,14 +339,48 @@ class Trainer:
         for i, batch in enumerate(it, start=skip_steps):
             if self.state is None:
                 self.init(batch)
+            self._maybe_profile(epoch, i)
             metrics = self.train_step(batch)
-            if (i + 1) % self.log_every == 0 and dist.is_main_process():
+            self._meter.update(self._batch_samples(batch))
+            if (i + 1) % self.log_every == 0:
                 vals = {k: float(v) for k, v in metrics.items()}
-                self.logger.log_step(epoch, i + 1, vals)
+                if self._watchdog is not None:
+                    self._watchdog.check(vals, self.state)
+                rate = self._meter.rate
+                if rate == rate:  # skip the warmup NaN
+                    vals["samples_per_s"] = rate
+                if dist.is_main_process():
+                    self.logger.log_step(epoch, i + 1, vals)
             if (self.checkpoint is not None and self._checkpoint_every > 0
                     and (i + 1) % self._checkpoint_every == 0):
                 self._save_checkpoint()
+        self._maybe_profile(epoch, -1)  # close an open capture at epoch end
         return {k: float(v) for k, v in metrics.items()}
+
+    @property
+    def throughput(self) -> float:
+        """samples/s over the recent window (compile step excluded)."""
+        return self._meter.rate
+
+    @staticmethod
+    def _batch_samples(batch) -> int:
+        return next(int(v.shape[0]) for v in batch.values()
+                    if hasattr(v, "shape") and v.ndim > 0)
+
+    def _maybe_profile(self, epoch: int, step: int) -> None:
+        """With profile_dir set, capture a device trace of steps 2-7 of the
+        first epoch (past compile, short enough to open in Perfetto)."""
+        if self.profile_dir is None or epoch != 0:
+            return
+        if step == 2 and not self._profiling:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and (step >= 8 or step < 0):
+            jax.profiler.stop_trace()
+            self._profiling = False
+            if dist.is_main_process():
+                self.logger.info(f"profile trace written to "
+                                 f"{self.profile_dir}")
 
     def _save_checkpoint(self, *, force: bool = False) -> None:
         """Save unless this step is already on disk (an epoch-end save can

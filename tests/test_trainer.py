@@ -116,3 +116,40 @@ def test_remat_matches_no_remat():
         return float(tr.train_step(batch)["loss"])
 
     assert one_step(False) == pytest.approx(one_step(True), rel=1e-6)
+
+
+def test_watchdog_kills_training_on_nan():
+    """SURVEY.md §5 wiring: an injected numeric blowup must stop fit() with
+    FloatingPointError, not train on garbage for the rest of the job."""
+    ds = SyntheticRegressionDataset(size=64, in_dim=20, out_dim=1)
+    ds.arrays["x"][7] = np.inf  # poison one sample
+    loader = DataLoader(ds, batch_size=32, num_replicas=1, rank=0)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), log_every=1)
+    with pytest.raises(FloatingPointError, match="loss"):
+        tr.fit(loader, max_epochs=1)
+
+
+def test_watchdog_off_by_flag():
+    ds = SyntheticRegressionDataset(size=64, in_dim=20, out_dim=1)
+    ds.arrays["x"][7] = np.inf
+    loader = DataLoader(ds, batch_size=32, num_replicas=1, rank=0)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), log_every=1, watchdog=False)
+    metrics = tr.fit(loader, max_epochs=1)  # runs to completion (on garbage)
+    assert not np.isfinite(metrics["loss"])
+
+
+def test_throughput_meter_feeds_logging():
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), log_every=2)
+    tr.fit(_make_loader(), max_epochs=2)
+    assert np.isfinite(tr.throughput) and tr.throughput > 0
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), profile_dir=str(tmp_path))
+    tr.fit(_make_loader(), max_epochs=1)
+    traces = list(tmp_path.rglob("*"))
+    assert any(p.is_file() for p in traces), "no trace files captured"
