@@ -5,9 +5,10 @@ capability surface exercised by the reference tutorial repo
 JoeyOL/PytorchDistributed (see SURVEY.md): process-group initialization and
 per-chip launching, data-parallel training with deterministic sharded sampling
 and gradient all-reduce over ICI, tensor/model sharding, micro-batched pipeline
-parallelism (GPipe / 1F1B), FSDP-style parameter+optimizer sharding with bf16
-and activation checkpointing, and sequence/context parallelism (ring attention,
-Ulysses) for long context.
+parallelism (GPipe and 1F1B schedules), FSDP-style parameter+optimizer sharding
+with bf16 and activation checkpointing, sequence/context parallelism (ring
+attention, Ulysses) for long context, Switch-MoE expert parallelism over the
+expert axis, and memory-budgeted auto placement (the device_map="auto" analog).
 
 Design stance (SURVEY.md §7): the reference's wrapper classes
 (DataParallel/DDP, reference ddp_gpus.py:35) become *sharding-spec choices over

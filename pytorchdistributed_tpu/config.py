@@ -26,7 +26,9 @@ class ExperimentConfig:
     attention: str = "dense"       # dense | pallas | ring | ulysses
     remat: bool = False
     # parallelism (mesh axis sizes; -1 = absorb remaining devices)
-    strategy: str = "dp"           # dp | fsdp | tp | tp_fsdp
+    strategy: str = "dp"           # dp | fsdp | tp | tp_fsdp | auto
+    device_memory_gb: float = 0.0  # per-chip HBM for --strategy auto
+                                   # (0 = query the device, v5e fallback)
     data: int = -1
     fsdp: int = 1
     tensor: int = 1
@@ -52,8 +54,11 @@ class ExperimentConfig:
     lr_end: float = 0.0
     grad_clip_norm: float = 0.0    # clip_by_global_norm; 0 = off
     seed: int = 0
-    # data shapes (synthetic datasets)
-    dataset_size: int = 2048
+    # data: real on-disk datasets when data_dir is set and populated
+    # (CIFAR-10 pickle batches or {split}_images/labels.npy pairs —
+    # data/files.py); synthetic fallback otherwise
+    data_dir: str = ""
+    dataset_size: int = 2048       # synthetic dataset size
     seq_len: int = 128
     image_size: int = 32
     num_classes: int = 10
@@ -77,14 +82,18 @@ PRESETS: dict[str, dict[str, Any]] = {
     "resnet50_imagenet_dp": dict(
         model="resnet50", image_size=224, num_classes=1000, strategy="dp",
         batch_size=64),
-    # configs[2]: BERT-base MLM, bf16
+    # configs[2]: BERT-base MLM, bf16 (warmup+linear decay, the BERT recipe)
     "bert_base_mlm": dict(
         model="bert", model_size="base", seq_len=512, strategy="dp",
-        batch_size=16, bf16=True),
+        batch_size=16, bf16=True, learning_rate=1e-4, lr_schedule="linear",
+        warmup_steps=1000, decay_steps=100_000, grad_clip_norm=1.0),
     # configs[3]: GPT-2-medium FSDP + activation checkpointing
+    # (warmup-cosine + clipping, the GPT recipe)
     "gpt2_medium_fsdp": dict(
         model="gpt2", model_size="medium", seq_len=1024, strategy="fsdp",
-        data=1, fsdp=-1, remat=True, batch_size=8),
+        data=1, fsdp=-1, remat=True, batch_size=8, learning_rate=3e-4,
+        lr_schedule="cosine", warmup_steps=500, decay_steps=50_000,
+        grad_clip_norm=1.0),
     # configs[4]: ViT-L/16 multi-host DP across pod slices
     "vit_l16_multihost": dict(
         model="vit", model_size="large", image_size=224, num_classes=1000,
@@ -154,11 +163,11 @@ def parse_cli(argv=None) -> ExperimentConfig:
     return ExperimentConfig(**values)
 
 
-def build(cfg: ExperimentConfig):
-    """(model, optimizer, loss_fn, mesh, dataset) from a config. Imports jax
-    lazily so select_backend can act first."""
+def _build_model(cfg: ExperimentConfig):
+    """(model, loss_fn, dataset) for a config — separated from `build` so
+    the auto-placement path can re-instantiate the model after the planner
+    picks a pipeline split."""
     import jax.numpy as jnp
-    import optax
 
     from pytorchdistributed_tpu import models
     from pytorchdistributed_tpu.data import (
@@ -166,7 +175,6 @@ def build(cfg: ExperimentConfig):
         SyntheticRegressionDataset,
         SyntheticTokenDataset,
     )
-    from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh
     from pytorchdistributed_tpu.training import (
         cross_entropy_loss,
         moe_token_cross_entropy_loss,
@@ -200,33 +208,127 @@ def build(cfg: ExperimentConfig):
             cfg.model_size, image_size=cfg.image_size,
             num_classes=cfg.num_classes, **tkw))
         loss = cross_entropy_loss
-        ds = SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
-                                   num_classes=cfg.num_classes, seed=cfg.seed)
+        ds = _image_dataset(cfg)
     elif cfg.model in ("resnet18", "resnet50"):
         maker = models.resnet18 if cfg.model == "resnet18" else models.resnet50
         model = maker(num_classes=cfg.num_classes, dtype=dtype,
                       **(dict(cifar_stem=True) if cfg.model == "resnet18"
                          and cfg.image_size <= 64 else {}))
         loss = cross_entropy_loss
-        ds = SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
-                                   num_classes=cfg.num_classes, seed=cfg.seed)
+        ds = _image_dataset(cfg)
     elif cfg.model == "mlp":
         model = models.MLP()
         loss = mse_loss
         ds = SyntheticRegressionDataset(cfg.dataset_size, seed=cfg.seed)
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
+    return model, loss, ds
 
+
+def _image_dataset(cfg: ExperimentConfig):
+    """Real on-disk data when --data_dir points at a populated directory
+    (CIFAR-10 pickle batches, or the {split}_images/labels.npy convention
+    for ImageNet-class sets), synthetic fallback otherwise — the BASELINE
+    img/s configs measure the real input pipeline when data is present."""
+    from pytorchdistributed_tpu.data import SyntheticImageDataset
+    from pytorchdistributed_tpu.data.files import load_cifar10, load_image_dir
+
+    if cfg.data_dir:
+        ds = (load_cifar10(cfg.data_dir) if cfg.image_size <= 32
+              else load_image_dir(cfg.data_dir))
+        if ds is None:
+            ds = load_image_dir(cfg.data_dir) or load_cifar10(cfg.data_dir)
+        if ds is not None:
+            if ds.num_classes != cfg.num_classes:
+                raise ValueError(
+                    f"--data_dir dataset has {ds.num_classes} classes but "
+                    f"the config expects {cfg.num_classes}")
+            return ds
+        print(f"[config] no dataset found under {cfg.data_dir!r}; "
+              f"falling back to synthetic data", flush=True)
+    return SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
+                                 num_classes=cfg.num_classes, seed=cfg.seed)
+
+
+def build(cfg: ExperimentConfig):
+    """(model, optimizer, loss_fn, mesh, dataset) from a config. Imports jax
+    lazily so select_backend can act first. ``strategy="auto"`` runs the
+    memory planner (parallel/auto.py — the device_map="auto" analog) and
+    rewrites strategy + mesh axes from its plan."""
+    from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh
+
+    if cfg.strategy == "auto":
+        cfg = _auto_place(cfg)
+    model, loss, ds = _build_model(cfg)
     mesh = create_mesh(MeshConfig(
         data=cfg.data, fsdp=cfg.fsdp, expert=cfg.expert, tensor=cfg.tensor,
         pipe=cfg.pipe, seq=cfg.seq, num_slices=cfg.num_slices))
+    return model, make_optimizer(cfg), loss, mesh, ds, cfg
+
+
+def _auto_place(cfg: ExperimentConfig) -> ExperimentConfig:
+    """Run the auto-shard planner against the model's real abstract params
+    (a scratch instantiation — nothing is allocated) and fold its
+    (strategy, mesh axes) back into the config."""
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+
+    from pytorchdistributed_tpu.parallel.auto import auto_shard
+
+    model, _, ds = _build_model(cfg)
+    sample = ds[np.arange(min(2, len(ds)))]
+    inputs = next(sample[k] for k in ("x", "image", "tokens") if k in sample)
+    mem = (cfg.device_memory_gb * 2**30) if cfg.device_memory_gb else None
+    plan = auto_shard(model, (inputs,), n_devices=len(jax.devices()),
+                      device_memory_bytes=mem, optimizer=cfg.optimizer)
+    cfg = _dc.replace(
+        cfg, strategy=plan.strategy, data=plan.mesh.data,
+        fsdp=plan.mesh.fsdp, tensor=plan.mesh.tensor, pipe=plan.mesh.pipe)
+    if plan.mesh.pipe > 1:
+        cfg = _dc.replace(cfg, pipeline_microbatches=max(
+            cfg.pipeline_microbatches, 2 * plan.mesh.pipe))
+    return cfg
+
+
+def make_lr_schedule(cfg: ExperimentConfig):
+    """Scalar or optax schedule: linear warmup to the peak learning_rate
+    over warmup_steps, then the configured decay (every BASELINE config past
+    the smoke test trains with warmup+decay in practice)."""
+    import optax
+
+    lr, w = cfg.learning_rate, cfg.warmup_steps
+    if cfg.lr_schedule == "constant":
+        if w == 0:
+            return lr
+        return optax.schedules.warmup_constant_schedule(0.0, lr, w)
+    if cfg.lr_schedule == "cosine":
+        return optax.schedules.warmup_cosine_decay_schedule(
+            0.0, lr, w, decay_steps=cfg.decay_steps, end_value=cfg.lr_end)
+    if cfg.lr_schedule == "linear":
+        warm = optax.schedules.linear_schedule(0.0, lr, max(w, 1))
+        decay = optax.schedules.linear_schedule(
+            lr, cfg.lr_end, max(cfg.decay_steps - w, 1))
+        return optax.schedules.join_schedules([warm, decay], [w])
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
+                     "(constant | cosine | linear)")
+
+
+def make_optimizer(cfg: ExperimentConfig):
+    """Optimizer chain: [global-norm clip →] adamw/sgd with the schedule."""
+    import optax
+
+    lr = make_lr_schedule(cfg)
     if cfg.optimizer == "adamw":
-        opt = optax.adamw(cfg.learning_rate)
+        opt = optax.adamw(lr)
     elif cfg.optimizer == "sgd":
-        opt = optax.sgd(cfg.learning_rate, momentum=0.9)
+        opt = optax.sgd(lr, momentum=0.9)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
-    return model, opt, loss, mesh, ds
+    if cfg.grad_clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt
 
 
 def make_trainer(cfg: ExperimentConfig):
@@ -235,7 +337,7 @@ def make_trainer(cfg: ExperimentConfig):
     from pytorchdistributed_tpu.parallel.precision import Policy
     from pytorchdistributed_tpu.training import Trainer
 
-    model, opt, loss, mesh, ds = build(cfg)
+    model, opt, loss, mesh, ds, cfg = build(cfg)
     loader = DataLoader(ds, batch_size=cfg.batch_size, seed=cfg.seed)
     trainer = Trainer(
         model, opt, loss, mesh=mesh, strategy=cfg.strategy,

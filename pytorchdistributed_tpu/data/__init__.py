@@ -6,3 +6,8 @@ from pytorchdistributed_tpu.data.datasets import (  # noqa: F401
     SyntheticImageDataset,
     SyntheticTokenDataset,
 )
+from pytorchdistributed_tpu.data.files import (  # noqa: F401
+    MappedImageDataset,
+    load_cifar10,
+    load_image_dir,
+)

@@ -1,0 +1,98 @@
+"""On-disk datasets — the real-data path behind the BASELINE configs
+(ResNet-18/**CIFAR-10**, ResNet-50/**ImageNet**), which the reference never
+has (its data is always synthetic, ddp_gpus.py:57-66). Zero-copy design:
+
+  * ``.npy`` files open with ``np.load(mmap_mode="r")`` — the OS page cache
+    is the shuffle buffer, nothing is loaded up front;
+  * batch assembly is the same vectorized row-gather as the synthetic path
+    (`ArrayDataset.__getitem__` → `_native.gather`, the multithreaded C++
+    copy in csrc/ptd_host.cc) — on 224×224 ImageNet rows (~600KB each) this
+    is where the native loader earns its keep;
+  * no downloads: if the files are absent the callers fall back to
+    synthetic data (the environment has no egress; provisioning data is the
+    operator's job).
+
+Layouts understood:
+  * ``<root>/<split>_images.npy`` + ``<root>/<split>_labels.npy`` — the
+    generic array-file convention (`MappedImageDataset`);
+  * ``<root>/cifar-10-batches-py/`` — the standard CIFAR-10 python pickle
+    distribution (`load_cifar10`), converted once to the ``.npy`` pair
+    beside it and memory-mapped thereafter.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import pickle
+
+import numpy as np
+
+from pytorchdistributed_tpu.data.datasets import ArrayDataset
+
+
+class MappedImageDataset(ArrayDataset):
+    """Memory-mapped ``{split}_images.npy`` / ``{split}_labels.npy`` pair.
+
+    Images may be stored uint8 (the compact on-disk form); they are
+    normalized to float32 per-batch AFTER the gather, so the mmap stays
+    byte-for-byte the file and the page cache is shared across processes.
+    """
+
+    def __init__(self, root: str | pathlib.Path, split: str = "train",
+                 mean: float = 0.0, scale: float = 1 / 255.0):
+        root = pathlib.Path(root)
+        images = np.load(root / f"{split}_images.npy", mmap_mode="r")
+        labels = np.load(root / f"{split}_labels.npy", mmap_mode="r")
+        self.num_classes = int(labels.max()) + 1
+        self._mean, self._scale = mean, scale
+        super().__init__({"image": images, "label": labels})
+
+    def __getitem__(self, idx):
+        batch = super().__getitem__(idx)
+        img = batch["image"]
+        if img.dtype != np.float32:
+            img = (img.astype(np.float32) - self._mean) * self._scale
+        return {"image": img,
+                "label": np.asarray(batch["label"], np.int32)}
+
+
+def _convert_cifar10(batches_dir: pathlib.Path, split: str) -> None:
+    """One-time conversion of the pickle batches to the ``.npy`` pair
+    (written beside ``cifar-10-batches-py/``): NCHW-packed rows → NHWC
+    uint8, the TPU-native image layout."""
+    names = ([f"data_batch_{i}" for i in range(1, 6)]
+             if split == "train" else ["test_batch"])
+    images, labels = [], []
+    for name in names:
+        with open(batches_dir / name, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        images.append(np.asarray(d[b"data"], np.uint8)
+                      .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        labels.append(np.asarray(d[b"labels"], np.int32))
+    root = batches_dir.parent
+    np.save(root / f"{split}_images.npy", np.concatenate(images))
+    np.save(root / f"{split}_labels.npy", np.concatenate(labels))
+
+
+def load_cifar10(root: str | pathlib.Path,
+                 split: str = "train") -> MappedImageDataset | None:
+    """CIFAR-10 from ``<root>/cifar-10-batches-py`` (or an already-converted
+    ``.npy`` pair under ``<root>``); None when neither exists — callers fall
+    back to synthetic data."""
+    root = pathlib.Path(root)
+    if not (root / f"{split}_images.npy").exists():
+        batches = root / "cifar-10-batches-py"
+        if not batches.exists():
+            return None
+        _convert_cifar10(batches, split)
+    return MappedImageDataset(root, split)
+
+
+def load_image_dir(root: str | pathlib.Path,
+                   split: str = "train") -> MappedImageDataset | None:
+    """Generic array-file dataset (the ImageNet-config path): the
+    ``{split}_images.npy``/``{split}_labels.npy`` convention, else None."""
+    root = pathlib.Path(root)
+    if not (root / f"{split}_images.npy").exists():
+        return None
+    return MappedImageDataset(root, split)

@@ -8,14 +8,17 @@ data/datasets.py's MLM shape: {tokens, targets, loss_mask}.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
+    TransformerBlock,
     TransformerConfig,
     TransformerStack,
     _dense_general,
     _layer_norm,
+    gather_free_ce,
 )
 from pytorchdistributed_tpu.parallel.tp import Logical
 
@@ -40,6 +43,85 @@ class BertMLM(nn.Module):
         x = _layer_norm(cfg, "mlm_ln")(x)
         logits = emb.attend(x)
         return logits.astype(jnp.float32)
+
+    @nn.nowrap
+    def pipeline_parts(self):
+        """1F1B decomposition (see GPT2.pipeline_parts): pre = embed +
+        ln_embed, stages = encoder layer groups, head = MLM transform +
+        tied decode + weighted CE. The masked-LM loss normalizes by the
+        GLOBAL mask count, so ``targets_of`` precomputes per-position
+        weights w = mask/Σmask; each micro-batch's head_loss is then
+        M·Σ(ce·w), making (1/M)·Σ losses equal the full-batch masked mean
+        exactly regardless of how masked tokens fall across micro-batches."""
+        from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
+
+        cfg = self.cfg
+        p = cfg.pipeline_stages
+        m = cfg.pipeline_microbatches
+        if cfg.num_layers % p:
+            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                             f"pipeline_stages {p}")
+        if not cfg.scan_layers:
+            raise ValueError("pipeline_parts requires scan_layers=True")
+        block = TransformerBlock(cfg, deterministic=True)
+
+        def split(params):
+            pp = params["params"]
+            stage = jax.tree.map(
+                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
+                pp["encoder"]["block"])
+            head = {"mlm_dense": pp["mlm_dense"], "mlm_ln": pp["mlm_ln"],
+                    "proj": pp["embed"]["tok"]["embedding"]}
+            pre = {"embed": pp["embed"], "ln_embed": pp["ln_embed"]}
+            return pre, stage, head
+
+        def pre_apply(pre, tokens):
+            x = Embedder(cfg).apply({"params": pre["embed"]}, tokens)
+            return _layer_norm(cfg, None).apply(
+                {"params": pre["ln_embed"]}, x).astype(cfg.dtype)
+
+        def stage_apply(stage_leaf, h):
+            def layer(h, lp):
+                return block.apply({"params": lp}, h), None
+
+            h, _ = jax.lax.scan(layer, h, stage_leaf)
+            return h
+
+        def targets_of(batch):
+            targets = batch["targets"]
+            mask = batch.get("loss_mask")
+            if mask is None:
+                mask = jnp.ones(targets.shape, jnp.float32)
+            w = mask.astype(jnp.float32) / jnp.maximum(mask.sum(), 1)
+            return {"targets": targets, "w": w}
+
+        def head_loss(head, h, t):
+            x = _dense_general(
+                cfg.embed_dim, (Logical.EMBED, Logical.MLP), cfg,
+                None).apply({"params": head["mlm_dense"]}, h)
+            x = nn.gelu(x)
+            x = _layer_norm(cfg, None).apply({"params": head["mlm_ln"]}, x)
+            logits = x.astype(cfg.dtype) @ head["proj"].astype(cfg.dtype).T
+            ce = gather_free_ce(logits, t["targets"])
+            # x M: the schedule averages micro-batch losses; the global
+            # weights w already carry the 1/Σmask normalization
+            return (ce * t["w"]).sum() * m
+
+        def merge_grads(pre_g, stage_g, head_g):
+            blocks = jax.tree.map(
+                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            embed_g = dict(pre_g["embed"])
+            tok = embed_g["tok"]
+            embed_g["tok"] = {"embedding": tok["embedding"] + head_g["proj"]}
+            return {"params": {
+                "embed": embed_g, "ln_embed": pre_g["ln_embed"],
+                "encoder": {"block": blocks},
+                "mlm_dense": head_g["mlm_dense"],
+                "mlm_ln": head_g["mlm_ln"],
+            }}
+
+        return PipelineParts(split, pre_apply, stage_apply, head_loss,
+                             merge_grads, targets_of)
 
 
 def bert_config(size: str = "base", **overrides) -> TransformerConfig:

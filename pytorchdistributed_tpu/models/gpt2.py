@@ -87,21 +87,15 @@ class GPT2(nn.Module):
             return h
 
         def head_loss(head, h, targets):
+            from pytorchdistributed_tpu.models.transformer import (
+                gather_free_ce,
+            )
+
             x = _layer_norm(cfg, None).apply({"params": head["ln_f"]}, h)
             proj = head["proj"].astype(cfg.dtype)
             logits = (x.astype(cfg.dtype) @ proj.T if cfg.tie_embeddings
-                      else x.astype(cfg.dtype) @ proj).astype(jnp.float32)
-            # Gather-free (vocab-parallel) cross-entropy: under TP the vocab
-            # dim is tensor-sharded, and a take-along-axis gather on a
-            # sharded dim inside the manual-pipe shard_map crashes XLA's
-            # SPMD partitioner — the one-hot contraction partitions cleanly
-            # (Megatron's vocab-parallel CE shape) and XLA reduces it to the
-            # same FLOPs.
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            true = jnp.einsum(
-                "bsv,bsv->bs", logits,
-                jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32))
-            return (lse - true).mean()
+                      else x.astype(cfg.dtype) @ proj)
+            return gather_free_ce(logits, targets).mean()
 
         def merge_grads(pre_g, stage_g, head_g):
             blocks = jax.tree.map(

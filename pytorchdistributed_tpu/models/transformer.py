@@ -86,6 +86,21 @@ class TransformerConfig:
         return self.mlp_dim if self.mlp_dim is not None else 4 * self.embed_dim
 
 
+def gather_free_ce(logits, targets):
+    """Per-position cross-entropy [b, s] via logsumexp − one-hot
+    contraction. Gather-free on purpose: under TP the vocab dim is
+    tensor-sharded, and a take-along-axis gather on a sharded dim inside a
+    manual-axis shard_map (the 1F1B pipeline) crashes XLA's SPMD
+    partitioner; the one-hot contraction partitions cleanly (Megatron's
+    vocab-parallel CE shape) and XLA reduces it to the same FLOPs."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    true = jnp.einsum(
+        "bsv,bsv->bs", logits,
+        jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32))
+    return lse - true
+
+
 def checkpoint_policy(name: str):
     """Map a remat_policy name to a jax.checkpoint policy (None = save
     nothing, recompute everything)."""

@@ -1,0 +1,212 @@
+"""Auto-placement — the TPU answer to HF's ``device_map="auto"``
+(reference 03_model_parallel.ipynb:86-89 (cell 1); its cell-0 markdown
+describes the GPU > CPU > Disk placement priority).
+
+On GPU the auto-placer solves "model bigger than one card" by *spilling*:
+put what fits on the GPU, overflow to CPU RAM, then disk. On TPU spilling
+over PCIe/DCN would strand the MXU, so the idiomatic resource ladder is
+*sharding axes*, grown until the training state fits per-chip HBM:
+
+  1. replicate (pure DP) while it fits — zero extra collectives;
+  2. grow the **fsdp** axis (ZeRO-3): state divides by the axis size, cost
+     is an all-gather per layer that overlaps with compute;
+  3. add **tensor** parallelism: also divides the big kernels, cost is
+     activation psums on the fastest ICI axis;
+  4. add **pipe** stages: divides the scanned layer stack, cost is the
+     pipeline bubble.
+
+The planner works on the model's *abstract* params (real shapes, logical
+axis names) and the same rule tables the Trainer shards with
+(parallel/tp.py), so "would fit" is computed from the actual sharding a
+strategy produces, not a heuristic fraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from pytorchdistributed_tpu.parallel.tp import logical_rules
+from pytorchdistributed_tpu.runtime.mesh import Axis, MeshConfig
+
+# Per-parameter training-state bytes: fp32 master copy + fp32 gradient +
+# optimizer slots (adam m,v / sgd momentum). Compute-dtype casts are
+# transient and covered by the headroom factor.
+_STATE_BYTES_PER_PARAM = {"adamw": 16, "adam": 16, "sgd": 12}
+
+
+@dataclasses.dataclass(frozen=True)
+class Leaf:
+    """A parameter leaf as the planner sees it: shape + per-dim logical
+    axis names (None = never sharded)."""
+
+    shape: tuple
+    names: tuple
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) if self.shape else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoPlan:
+    mesh: MeshConfig
+    strategy: str
+    per_device_state_bytes: int
+    total_state_bytes: int
+
+    def describe(self) -> str:
+        gb = self.per_device_state_bytes / 2**30
+        return (f"strategy={self.strategy} mesh={self.mesh} "
+                f"state/device={gb:.2f}GiB")
+
+
+def leaves_of(abstract_boxed_params) -> list[Leaf]:
+    """Flatten a boxed abstract param tree (what `jax.eval_shape` of
+    `model.init` returns) into planner leaves."""
+    out = []
+    for leaf in jax.tree.leaves(
+            abstract_boxed_params,
+            is_leaf=lambda x: isinstance(x, nn.Partitioned)):
+        if isinstance(leaf, nn.Partitioned):
+            names = tuple(leaf.names)
+            shape = leaf.value.shape
+        else:
+            names = (None,) * getattr(leaf, "ndim", 0)
+            shape = getattr(leaf, "shape", ())
+        out.append(Leaf(tuple(shape), names))
+    return out
+
+
+def _shard_factor(leaf: Leaf, rules: dict, sizes: dict) -> int:
+    """How many ways the given mesh sizes split this leaf under the rules —
+    mirrors NamedSharding semantics: a dim divides only if the mapped axis
+    size divides it evenly, and a mesh axis can shard at most one dim of a
+    leaf (first dim wins, like PartitionSpec construction)."""
+    factor = 1
+    used: set = set()
+    for dim, name in zip(leaf.shape, leaf.names):
+        axes = rules.get(name)
+        if axes is None:
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        axes = tuple(a for a in axes if a not in used)
+        n = math.prod(sizes.get(a, 1) for a in axes)
+        if n > 1 and dim % n == 0:
+            factor *= n
+            used.update(axes)
+    return factor
+
+
+def _per_device_bytes(leaves, strategy: str, sizes: dict,
+                      optimizer: str) -> int:
+    rules = dict(logical_rules(strategy))
+    per_param = _STATE_BYTES_PER_PARAM.get(optimizer, 16)
+    return sum(
+        leaf.size * per_param // _shard_factor(leaf, rules, sizes)
+        for leaf in leaves)
+
+
+def _pow2_divisors(n: int):
+    d, out = 1, []
+    while d <= n:
+        if n % d == 0:
+            out.append(d)
+        d *= 2
+    return out
+
+
+def plan_auto_shard(
+    leaves: list[Leaf],
+    n_devices: int,
+    device_memory_bytes: float,
+    *,
+    optimizer: str = "adamw",
+    headroom: float = 0.35,
+) -> AutoPlan:
+    """Pick the smallest-sharding (MeshConfig, strategy) whose per-device
+    training state fits in ``(1-headroom) * device_memory_bytes``.
+
+    ``headroom`` reserves HBM for activations, collective buffers and XLA
+    scratch — state is the statically-knowable part; activations depend on
+    batch size, which the caller still controls.
+    """
+    budget = device_memory_bytes * (1.0 - headroom)
+    total = _per_device_bytes(leaves, "dp", {}, optimizer)
+    # pipe only helps models with a scanned (stage-stacked) layer axis
+    from pytorchdistributed_tpu.parallel.tp import Logical
+
+    has_stages = any(Logical.STAGE in leaf.names for leaf in leaves)
+
+    candidates: list[tuple[str, dict]] = [("dp", {})]
+    for f in _pow2_divisors(n_devices):
+        if f > 1:
+            candidates.append(("fsdp", {Axis.FSDP: f}))
+    for t in _pow2_divisors(n_devices):
+        if t > 1:
+            candidates.append(
+                ("tp_fsdp", {Axis.FSDP: n_devices // t, Axis.TENSOR: t}))
+    if has_stages:
+        for p in _pow2_divisors(n_devices):
+            for t in _pow2_divisors(n_devices // p):
+                if p > 1:
+                    candidates.append(("tp_fsdp", {
+                        Axis.FSDP: n_devices // (p * t), Axis.TENSOR: t,
+                        Axis.PIPE: p}))
+
+    for strategy, sizes in candidates:
+        if math.prod(sizes.values()) > n_devices:
+            continue
+        per_dev = _per_device_bytes(leaves, strategy, sizes, optimizer)
+        if per_dev <= budget:
+            mesh = MeshConfig(
+                data=-1,
+                fsdp=sizes.get(Axis.FSDP, 1),
+                tensor=sizes.get(Axis.TENSOR, 1),
+                pipe=sizes.get(Axis.PIPE, 1),
+            )
+            return AutoPlan(mesh, strategy, per_dev, total)
+
+    raise ValueError(
+        f"model state ({total / 2**30:.2f}GiB replicated) does not fit "
+        f"{n_devices} devices x {device_memory_bytes / 2**30:.2f}GiB even "
+        f"fully sharded — more chips or a smaller model")
+
+
+def auto_shard(model, sample_batch_inputs, *, n_devices: int | None = None,
+               device_memory_bytes: float | None = None,
+               optimizer: str = "adamw", seed: int = 0) -> AutoPlan:
+    """`plan_auto_shard` from a live model: abstract-init (no memory
+    allocated) to recover shapes + logical names, then plan.
+
+    ``sample_batch_inputs``: the positional inputs ``model.init`` takes
+    (e.g. a token array). Returns an AutoPlan whose ``mesh`` /
+    ``strategy`` feed `create_mesh` and the Trainer.
+    """
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if device_memory_bytes is None:
+        device_memory_bytes = _device_memory_bytes()
+    abstract = jax.eval_shape(
+        lambda r, *a: model.init(r, *a),
+        jax.random.key(seed), *sample_batch_inputs)
+    return plan_auto_shard(
+        leaves_of(abstract), n_devices, device_memory_bytes,
+        optimizer=optimizer)
+
+
+def _device_memory_bytes() -> float:
+    """Per-chip HBM from the runtime, with a v5e-sized fallback when the
+    backend doesn't report it (CPU sim)."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16.0 * 2**30

@@ -197,7 +197,11 @@ class PipelineParts:
       * ``head_loss(head, h, targets) -> scalar fp32``: final projection +
         loss, fused into the last stage;
       * ``merge_grads(pre_g, stage_g, head_g)`` -> grads shaped like the full
-        param tree (summing any tied leaves, e.g. GPT-2's tied embedding).
+        param tree (summing any tied leaves, e.g. GPT-2's tied embedding);
+      * ``targets_of(batch)`` (optional): the pytree handed to head_loss per
+        micro-batch — lets a model precompute globally-normalized loss
+        weights (masked LM) so per-micro-batch losses still sum exactly to
+        the full-batch loss. Default: ``batch["targets"]``.
     """
 
     split: Callable
@@ -205,6 +209,7 @@ class PipelineParts:
     stage_apply: Callable
     head_loss: Callable
     merge_grads: Callable
+    targets_of: Callable | None = None
 
 
 def _require_pipe_mesh(mesh, who: str):

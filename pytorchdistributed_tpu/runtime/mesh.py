@@ -118,20 +118,7 @@ def create_mesh(
     shape = tuple(sizes[a] for a in Axis.ALL)
 
     if config.num_slices > 1:
-        # Multi-slice: the "data" axis rides DCN, everything else stays on the
-        # ICI torus within a slice.
-        if sizes[Axis.DATA] % config.num_slices != 0:
-            raise ValueError(
-                f"data axis {sizes[Axis.DATA]} must be a multiple of "
-                f"num_slices {config.num_slices}"
-            )
-        per_slice = list(shape)
-        per_slice[0] = sizes[Axis.DATA] // config.num_slices
-        dcn = [1] * len(shape)
-        dcn[0] = config.num_slices
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, dcn, devices=devices, allow_split_physical_axes=True
-        )
+        device_array = hybrid_device_array(config.num_slices, shape, devices)
     else:
         try:
             device_array = mesh_utils.create_device_mesh(
@@ -142,6 +129,39 @@ def create_mesh(
             device_array = np.asarray(devices).reshape(shape)
 
     return Mesh(device_array, Axis.ALL)
+
+
+def hybrid_device_array(num_slices: int, shape: tuple,
+                        devices: Sequence[Any]) -> np.ndarray:
+    """Device layout for a multi-slice (ICI x DCN) pod: the "data" axis
+    (axis 0 of ``shape``) spans slices — each slice's devices fill a
+    contiguous block of data rows, so every other axis's collectives stay on
+    intra-slice ICI and only data-parallel gradient reduction crosses DCN
+    (SURVEY.md §5 "Distributed communication backend").
+
+    Uses `mesh_utils.create_hybrid_device_mesh` on real TPU topologies and
+    falls back to a slice-major reshape when devices carry no topology
+    (CPU sim, fake test devices): sorted by (slice_index, id), slice k
+    occupies data rows [k*D/S, (k+1)*D/S).
+    """
+    data_total = shape[0]
+    if data_total % num_slices != 0:
+        raise ValueError(
+            f"data axis {data_total} must be a multiple of "
+            f"num_slices {num_slices}")
+    per_slice = list(shape)
+    per_slice[0] = data_total // num_slices
+    dcn = [1] * len(shape)
+    dcn[0] = num_slices
+    try:
+        return mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices, allow_split_physical_axes=True
+        )
+    except (ValueError, AttributeError, NotImplementedError):
+        devs = sorted(devices,
+                      key=lambda d: (getattr(d, "slice_index", 0),
+                                     getattr(d, "id", 0)))
+        return np.asarray(devs, dtype=object).reshape(shape)
 
 
 def local_mesh(n: int | None = None) -> Mesh:

@@ -50,6 +50,20 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
+def default_batch_adapter(batch) -> tuple:
+    """batch dict → the model's positional inputs. The default serves the
+    built-in task shapes (regression "x", vision "image", LM "tokens");
+    models with richer signatures (attention masks, segment ids) pass an
+    explicit ``batch_adapter`` to the Trainer — the loss_fn they bring reads
+    the same batch keys itself."""
+    for key in ("x", "image", "tokens"):
+        if key in batch:
+            return (batch[key],)
+    raise ValueError(
+        f"cannot infer model inputs from batch keys {list(batch)}; pass "
+        f"Trainer(batch_adapter=...) mapping the batch to model args")
+
+
 class Trainer:
     """``Trainer(model, optimizer, loss_fn).fit(loader, max_epochs)``.
 
@@ -75,6 +89,7 @@ class Trainer:
         checkpoint_every_steps: int = 0,
         watchdog: bool = True,
         profile_dir: str | None = None,
+        batch_adapter: Callable | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -96,6 +111,7 @@ class Trainer:
                 save_interval_steps=max(checkpoint_every_steps, 1))
         self.logger = MetricLogger()
         self._loss_fn = loss_fn
+        self._batch_adapter = batch_adapter or default_batch_adapter
         self._steps_per_epoch: int | None = None
         # SURVEY.md §5 wiring: the watchdog checks metrics at log cadence
         # (a float() on a device value blocks on the step, so an every-step
@@ -171,10 +187,7 @@ class Trainer:
         return self.state
 
     def _model_args(self, batch):
-        for key in ("x", "image", "tokens"):
-            if key in batch:
-                return (batch[key],)
-        raise ValueError(f"cannot infer model input from batch keys {list(batch)}")
+        return self._batch_adapter(batch)
 
     # -- the jitted hot loop ----------------------------------------------
 
@@ -279,6 +292,8 @@ class Trainer:
 
         def step(state: TrainState, batch):
             cparams = policy.cast_params_for_compute(state.params)
+            targets = (parts.targets_of(batch) if parts.targets_of
+                       else batch["targets"])
             with nn.logical_axis_rules(self._rules):
                 pre_p, stage_p, head_p = parts.split(cparams)
                 x, pre_vjp = jax.vjp(
@@ -286,7 +301,7 @@ class Trainer:
                     pre_p)
                 loss, stage_g, head_g, dx = one_f_one_b(
                     parts.stage_apply, stage_p, parts.head_loss, head_p,
-                    x, batch["targets"],
+                    x, targets,
                     num_microbatches=cfg.pipeline_microbatches,
                     mesh=self.mesh)
                 (pre_g,) = pre_vjp(dx)

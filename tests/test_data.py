@@ -133,3 +133,80 @@ class TestDeviceFeeding:
         dev = [b["x"] for b in prefetch_to_device(iter(dl), batch_sharding(mesh))]
         assert len(dev) == len(host)
         np.testing.assert_allclose(np.asarray(dev[0]), host[0])
+
+
+# ---- on-disk real-data path (data/files.py) -------------------------------
+
+
+def _write_fake_cifar(root, n_per_batch=20):
+    """The standard cifar-10-batches-py pickle layout, tiny."""
+    import pickle
+
+    d = root / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = {
+            b"data": rng.integers(0, 256, (n_per_batch, 3072), dtype=np.uint8),
+            b"labels": rng.integers(0, 10, (n_per_batch,)).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(data, f)
+    return root
+
+
+def test_cifar10_loads_and_converts(tmp_path):
+    from pytorchdistributed_tpu.data import load_cifar10
+
+    _write_fake_cifar(tmp_path)
+    ds = load_cifar10(tmp_path)
+    assert len(ds) == 100  # 5 batches x 20
+    assert (tmp_path / "train_images.npy").exists()  # one-time conversion
+    batch = ds[np.arange(8)]
+    assert batch["image"].shape == (8, 32, 32, 3)
+    assert batch["image"].dtype == np.float32
+    assert 0.0 <= batch["image"].min() and batch["image"].max() <= 1.0
+    assert batch["label"].dtype == np.int32
+    # second load goes straight to the mmap, same content
+    again = load_cifar10(tmp_path)[np.arange(8)]
+    np.testing.assert_array_equal(batch["image"], again["image"])
+    test = load_cifar10(tmp_path, "test")
+    assert len(test) == 20
+
+
+def test_cifar10_absent_returns_none(tmp_path):
+    from pytorchdistributed_tpu.data import load_cifar10
+
+    assert load_cifar10(tmp_path) is None
+
+
+def test_mapped_dataset_gather_matches_mmap(tmp_path):
+    from pytorchdistributed_tpu.data import MappedImageDataset
+
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (64, 8, 8, 3), dtype=np.uint8)
+    np.save(tmp_path / "train_images.npy", imgs)
+    np.save(tmp_path / "train_labels.npy",
+            rng.integers(0, 5, (64,), dtype=np.int32))
+    ds = MappedImageDataset(tmp_path)
+    assert ds.num_classes == 5
+    idx = np.asarray([5, 0, 63, 5], dtype=np.int64)
+    batch = ds[idx]
+    np.testing.assert_allclose(batch["image"],
+                               imgs[idx].astype(np.float32) / 255.0)
+
+
+def test_preset_trains_on_real_cifar(tmp_path):
+    """The resnet18_cifar_smoke preset picks up real CIFAR-10 when
+    --data_dir has it (VERDICT r1 item 5)."""
+    from pytorchdistributed_tpu.config import parse_cli, make_trainer
+    from pytorchdistributed_tpu.data.files import MappedImageDataset
+
+    _write_fake_cifar(tmp_path)
+    cfg = parse_cli(["--preset", "resnet18_cifar_smoke",
+                     "--data_dir", str(tmp_path), "--batch_size", "16",
+                     "--backend", "auto"])
+    trainer, loader = make_trainer(cfg)
+    assert isinstance(loader.dataset, MappedImageDataset)
+    batch = next(iter(loader))
+    assert np.isfinite(float(trainer.train_step(batch)["loss"]))

@@ -1,6 +1,7 @@
 """Mesh construction tests (SURVEY.md §7 step 1)."""
 
 import jax
+import numpy as np
 import pytest
 
 from pytorchdistributed_tpu.runtime.mesh import (
@@ -82,3 +83,61 @@ def test_sharded_array_round_trip():
     xs = jax.device_put(x, batch_sharding(mesh))
     assert len(xs.sharding.device_set) == 8
     assert (jax.device_get(xs) == jax.device_get(x)).all()
+
+
+class _FakeDev:
+    """A device with only topology attributes — what the hybrid layout
+    fallback keys on."""
+
+    def __init__(self, id, slice_index):
+        self.id = id
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}s{self.slice_index}"
+
+
+def test_hybrid_layout_data_axis_spans_slices():
+    """Multi-slice rule (SURVEY.md §5): only the data axis crosses DCN.
+    Every non-data mesh row must stay within one slice; the data axis must
+    touch all slices."""
+    from pytorchdistributed_tpu.runtime.mesh import hybrid_device_array
+
+    # 2 slices x 8 devices, interleaved ids to exercise the sort
+    devs = [_FakeDev(i, slice_index=i % 2) for i in range(16)]
+    shape = (4, 2, 1, 1, 1, 2)  # data=4 (2 per slice), fsdp=2, tensor=2
+    arr = hybrid_device_array(2, shape, devs)
+    assert arr.shape == shape
+    slice_of = np.vectorize(lambda d: d.slice_index)(arr)
+    # data rows 0-1 on slice 0, rows 2-3 on slice 1
+    for i in range(shape[0]):
+        row = slice_of[i]
+        assert (row == row.flat[0]).all(), (
+            f"data row {i} mixes slices: intra-slice axes would ride DCN")
+    assert set(slice_of[:, 0, 0, 0, 0, 0]) == {0, 1}, (
+        "data axis does not span both slices")
+
+
+def test_hybrid_layout_validates_divisibility():
+    from pytorchdistributed_tpu.runtime.mesh import hybrid_device_array
+
+    devs = [_FakeDev(i, 0) for i in range(8)]
+    with pytest.raises(ValueError, match="multiple of"):
+        hybrid_device_array(3, (8, 1, 1, 1, 1, 1), devs)
+
+
+def test_multislice_mesh_trains_on_cpu_sim():
+    """The vit_l16_multihost topology (num_slices=2) builds a mesh on the
+    CPU sim via the reshape fallback and runs a real sharded step."""
+    import numpy as _np
+    import optax
+
+    from pytorchdistributed_tpu.models import LinearRegression
+    from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+    mesh = create_mesh(MeshConfig(data=-1, num_slices=2))
+    assert mesh.shape[Axis.DATA] == 8
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss, mesh=mesh)
+    batch = {"x": _np.random.rand(32, 20).astype(_np.float32),
+             "y": _np.random.rand(32, 1).astype(_np.float32)}
+    assert _np.isfinite(float(tr.train_step(batch)["loss"]))

@@ -107,6 +107,34 @@ def test_gpt2_pipeline_loss_equivalence(sequential_losses, pp_kw, axes,
     np.testing.assert_allclose(got, sequential_losses, atol=2e-5)
 
 
+def test_bert_1f1b_masked_loss_equivalence():
+    """BERT MLM under 1F1B: the globally-normalized mask weights must make
+    micro-batch losses compose to exactly the full-batch masked mean, no
+    matter how unevenly masked tokens fall across micro-batches."""
+    from pytorchdistributed_tpu.models import BertMLM, bert_config
+
+    rng = np.random.default_rng(9)
+    batch = {
+        "tokens": rng.integers(0, 128, (16, 32)).astype(np.int32),
+        "targets": rng.integers(0, 128, (16, 32)).astype(np.int32),
+        # lopsided mask: rows 0-3 heavily masked, rows 12-15 barely
+        "loss_mask": (rng.random((16, 32)) <
+                      np.linspace(0.9, 0.05, 16)[:, None]).astype(np.int32),
+    }
+
+    def run(cfg_kw, axes, steps=3):
+        model = BertMLM(bert_config("test", num_layers=4, dtype=jnp.float32,
+                                    **cfg_kw))
+        tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(**axes), strategy="dp")
+        return [float(tr.train_step(batch)["loss"]) for _ in range(steps)]
+
+    seq = run(dict(), dict())
+    f1b = run(dict(pipeline_stages=4, pipeline_microbatches=4,
+                   pp_schedule="1f1b"), dict(data=2, pipe=4))
+    np.testing.assert_allclose(f1b, seq, atol=2e-5)
+
+
 def test_one_f_one_b_matches_sequential_grads():
     """Core 1F1B primitive: loss, stage grads, head grads and the input
     cotangent all equal sequential AD (the PipeDream-flush schedule is a

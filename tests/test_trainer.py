@@ -147,6 +147,41 @@ def test_throughput_meter_feeds_logging():
     assert np.isfinite(tr.throughput) and tr.throughput > 0
 
 
+def test_batch_adapter_multi_input_model():
+    """A model with two positional inputs (values + mask) trains through an
+    explicit batch_adapter — the contract key-probing could never express."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class MaskedRegressor(nn.Module):
+        @nn.compact
+        def __call__(self, x, mask):
+            return nn.Dense(1)(x * mask[..., None])
+
+    def masked_mse(model, params, batch, rng=None):
+        pred = model.apply(params, batch["x"], batch["mask"])
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    rng = np.random.default_rng(5)
+    batch = {
+        "x": rng.random((32, 8)).astype(np.float32),
+        "mask": np.ones((32,), np.float32),
+        "y": rng.random((32, 1)).astype(np.float32),
+    }
+    tr = Trainer(MaskedRegressor(), optax.sgd(1e-2), masked_mse,
+                 mesh=create_mesh(),
+                 batch_adapter=lambda b: (b["x"], b["mask"]))
+    assert np.isfinite(float(tr.train_step(batch)["loss"]))
+
+
+def test_unknown_batch_keys_error_mentions_adapter():
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh())
+    with pytest.raises(ValueError, match="batch_adapter"):
+        tr.train_step({"weird": np.zeros((8, 20), np.float32)})
+
+
 def test_profile_flag_writes_trace(tmp_path):
     tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
                  mesh=create_mesh(), profile_dir=str(tmp_path))
