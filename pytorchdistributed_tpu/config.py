@@ -21,7 +21,7 @@ from typing import Any
 @dataclasses.dataclass
 class ExperimentConfig:
     # model
-    model: str = "gpt2"            # gpt2 | bert | vit | resnet18 | resnet50 | mlp
+    model: str = "gpt2"            # gpt2 | llama | bert | vit | resnet18 | resnet50 | mlp
     model_size: str = "test"       # per-family size preset
     attention: str = "dense"       # dense | pallas | ring | ulysses
     remat: bool = False
@@ -191,15 +191,14 @@ def _build_model(cfg: ExperimentConfig):
                pipeline_microbatches=cfg.pipeline_microbatches,
                pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts)
 
-    if cfg.model == "gpt2":
-        model = models.GPT2(models.gpt2_config(
-            cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
-        loss = token_cross_entropy_loss
-        ds = SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
-                                   model.cfg.vocab_size, cfg.seed)
-    elif cfg.model == "bert":
-        model = models.BertMLM(models.bert_config(
-            cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
+    lm_families = {
+        "gpt2": (models.GPT2, models.gpt2_config),
+        "llama": (models.Llama, models.llama_config),
+        "bert": (models.BertMLM, models.bert_config),
+    }
+    if cfg.model in lm_families:
+        cls, make_cfg = lm_families[cfg.model]
+        model = cls(make_cfg(cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
         loss = token_cross_entropy_loss
         ds = SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
                                    model.cfg.vocab_size, cfg.seed)

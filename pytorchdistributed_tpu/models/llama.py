@@ -1,0 +1,132 @@
+"""Llama-family causal LM — the working TPU-native replacement for the
+reference's failed ``LlamaForCausalLM.from_pretrained("decanlp/llama-7b-hf",
+device_map="auto")`` demo (reference 03_model_parallel.ipynb:86-89, cell 1;
+it never ran for lack of network). Here the model is defined natively on the
+shared TransformerStack with the Llama dialect knobs flipped (RMSNorm,
+SwiGLU, RoPE, grouped-query attention, no biases, untied LM head), so every
+parallel strategy — DDP/FSDP/TP/PP/SP and ``--strategy auto``, the
+device_map analog (parallel/auto.py) — applies unmodified.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.models.transformer import (
+    Embedder,
+    LMHead,
+    TransformerBlock,
+    TransformerConfig,
+    TransformerStack,
+    _layer_norm,
+    gather_free_ce,
+)
+
+
+class Llama(nn.Module):
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed = Embedder(cfg)
+        self.h = TransformerStack(cfg)
+        self.ln_f = _layer_norm(cfg, None)
+        self.lm_head = LMHead(cfg)
+
+    def _backbone(self, tokens, deterministic):
+        x = self.embed(tokens)
+        x = self.h(x, deterministic=deterministic)
+        return self.ln_f(x)
+
+    def __call__(self, tokens, *, deterministic: bool = True):
+        x = self._backbone(tokens, deterministic)
+        return self.lm_head(x).astype(jnp.float32)
+
+    def loss_per_position(self, tokens, targets, *,
+                          deterministic: bool = True):
+        """Fused chunked-CE head (see GPT2.loss_per_position)."""
+        from pytorchdistributed_tpu.ops.fused_ce import chunked_softmax_ce
+
+        cfg = self.cfg
+        x = self._backbone(tokens, deterministic)
+        return chunked_softmax_ce(
+            x.astype(cfg.dtype), self.lm_head.kernel.astype(cfg.dtype),
+            targets, transpose_w=False)
+
+    @nn.nowrap
+    def pipeline_parts(self):
+        """1F1B decomposition (see GPT2.pipeline_parts): pre = token embed,
+        stages = layer groups, head = ln_f + untied lm_head + CE. No tied
+        embedding, so grads merge without summing contributions."""
+        from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
+
+        cfg = self.cfg
+        p = cfg.pipeline_stages
+        if cfg.num_layers % p:
+            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                             f"pipeline_stages {p}")
+        if not cfg.scan_layers:
+            raise ValueError("pipeline_parts requires scan_layers=True")
+        block = TransformerBlock(cfg, deterministic=True)
+
+        def split(params):
+            pp = params["params"]
+            stage = jax.tree.map(
+                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
+                pp["h"]["block"])
+            head = {"ln_f": pp["ln_f"], "proj": pp["lm_head"]["kernel"]}
+            return pp["embed"], stage, head
+
+        def pre_apply(pre, tokens):
+            return Embedder(cfg).apply({"params": pre}, tokens)
+
+        def stage_apply(stage_leaf, h):
+            def layer(h, lp):
+                return block.apply({"params": lp}, h), None
+
+            h, _ = jax.lax.scan(layer, h, stage_leaf)
+            return h
+
+        def head_loss(head, h, targets):
+            x = _layer_norm(cfg, None).apply({"params": head["ln_f"]}, h)
+            logits = x.astype(cfg.dtype) @ head["proj"].astype(cfg.dtype)
+            return gather_free_ce(logits, targets).mean()
+
+        def merge_grads(pre_g, stage_g, head_g):
+            blocks = jax.tree.map(
+                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            return {"params": {
+                "embed": pre_g, "h": {"block": blocks},
+                "ln_f": head_g["ln_f"],
+                "lm_head": {"kernel": head_g["proj"]},
+            }}
+
+        return PipelineParts(split, pre_apply, stage_apply, head_loss,
+                             merge_grads)
+
+
+def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
+    """Llama-2/3-style sizes. mlp_dim follows the released models (the
+    2/3·4·d multiple-of-256 rule baked in as literals)."""
+    presets = {
+        "test": dict(num_layers=2, embed_dim=64, num_heads=4, num_kv_heads=2,
+                     mlp_dim=128, vocab_size=128, max_seq_len=128),
+        "1b": dict(num_layers=16, embed_dim=2048, num_heads=32,
+                   num_kv_heads=8, mlp_dim=8192),
+        "7b": dict(num_layers=32, embed_dim=4096, num_heads=32,
+                   num_kv_heads=32, mlp_dim=11008),
+        "8b": dict(num_layers=32, embed_dim=4096, num_heads=32,
+                   num_kv_heads=8, mlp_dim=14336, rope_theta=500000.0),
+        "13b": dict(num_layers=40, embed_dim=5120, num_heads=40,
+                    num_kv_heads=40, mlp_dim=13824),
+        "70b": dict(num_layers=80, embed_dim=8192, num_heads=64,
+                    num_kv_heads=8, mlp_dim=28672),
+    }
+    kw = dict(vocab_size=32000, max_seq_len=4096, causal=True,
+              norm="rmsnorm", activation="swiglu", rope=True,
+              num_kv_heads=None, use_bias=False, tie_embeddings=False)
+    kw.update(presets[size])
+    kw.update(overrides)
+    return TransformerConfig(**kw)

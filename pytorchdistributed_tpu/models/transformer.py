@@ -50,6 +50,17 @@ class TransformerConfig:
     dtype: Dtype = jnp.bfloat16         # compute dtype (MXU)
     param_dtype: Dtype = jnp.float32
     attention: str = "dense"            # dense | pallas | ring | ulysses
+    # Architecture dialect knobs (GPT-2/BERT/ViT use the defaults; the Llama
+    # family — models/llama.py, the working replacement for the reference's
+    # failed llama-7b auto-shard cell, 03_model_parallel.ipynb:86-89 — flips
+    # all four). One shared core: every strategy applies to every dialect.
+    norm: str = "layernorm"             # layernorm | rmsnorm
+    activation: str = "gelu"            # gelu | swiglu
+    rope: bool = False                  # rotary position embedding (no
+    #                                     learned pos table when True)
+    rope_theta: float = 10000.0
+    num_kv_heads: int | None = None     # < num_heads = grouped-query attn
+    use_bias: bool = True               # Llama: no biases anywhere
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -80,6 +91,18 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.embed_dim // self.num_heads
+
+    def __post_init__(self):
+        kv = self.kv_heads
+        if kv <= 0 or self.num_heads % kv:
+            raise ValueError(
+                f"num_kv_heads {kv} must be a positive divisor of "
+                f"num_heads {self.num_heads}")
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
     @property
     def ffn_dim(self) -> int:
@@ -185,39 +208,69 @@ class SelfAttention(nn.Module):
         # q, k and v of its heads locally (the Megatron attention shard).
         # Explicit params: nn.DenseGeneral flattens multi-dim features for
         # its kernel init, which breaks rank-3 logical partitioning.
-        qkv_kernel = self.param(
-            "qkv_kernel",
-            nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02),
-                (Logical.EMBED, None, Logical.HEADS)),
-            (cfg.embed_dim, 3, cfg.num_heads * cfg.head_dim),
-            cfg.param_dtype,
-        )
-        qkv_bias = self.param(
-            "qkv_bias",
-            nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (None, Logical.HEADS)),
-            (3, cfg.num_heads * cfg.head_dim),
-            cfg.param_dtype,
-        )
-        fused = jnp.einsum(
-            "bse,ecf->bscf", x, qkv_kernel.astype(cfg.dtype),
-        ) + qkv_bias.astype(cfg.dtype)
-
-        def heads(t):
-            t = t.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        # Grouped-query attention (kv_heads < num_heads) splits into a q
+        # kernel + a fused [embed, 2, kv_heads·head_dim] kv kernel — both
+        # still shard whole heads on the "heads" logical axis.
+        def heads(t, n):
+            t = t.reshape(b, s, n, cfg.head_dim)
             return nn.with_logical_constraint(
                 t, (Logical.BATCH, Logical.SEQ, Logical.HEADS, Logical.KV))
 
-        q = heads(fused[..., 0, :])
-        k = heads(fused[..., 1, :])
-        v = heads(fused[..., 2, :])
+        def fused_proj(name, stack, width):
+            kernel = self.param(
+                f"{name}_kernel",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    (Logical.EMBED, None, Logical.HEADS) if stack > 1
+                    else (Logical.EMBED, Logical.HEADS)),
+                (cfg.embed_dim, stack, width) if stack > 1
+                else (cfg.embed_dim, width),
+                cfg.param_dtype,
+            )
+            eq = "bse,ecf->bscf" if stack > 1 else "bse,ef->bsf"
+            out = jnp.einsum(eq, x, kernel.astype(cfg.dtype))
+            if cfg.use_bias:
+                bias = self.param(
+                    f"{name}_bias",
+                    nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(),
+                        (None, Logical.HEADS) if stack > 1
+                        else (Logical.HEADS,)),
+                    (stack, width) if stack > 1 else (width,),
+                    cfg.param_dtype,
+                )
+                out = out + bias.astype(cfg.dtype)
+            return out
+
+        if cfg.kv_heads == cfg.num_heads:
+            fused = fused_proj("qkv", 3, cfg.num_heads * cfg.head_dim)
+            q = heads(fused[..., 0, :], cfg.num_heads)
+            k = heads(fused[..., 1, :], cfg.num_heads)
+            v = heads(fused[..., 2, :], cfg.num_heads)
+        else:
+            q = heads(fused_proj("q", 1, cfg.num_heads * cfg.head_dim),
+                      cfg.num_heads)
+            kv = fused_proj("kv", 2, cfg.kv_heads * cfg.head_dim)
+            k = heads(kv[..., 0, :], cfg.kv_heads)
+            v = heads(kv[..., 1, :], cfg.kv_heads)
+
+        if cfg.rope:
+            cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        if cfg.kv_heads != cfg.num_heads:
+            # Broadcast KV groups to full head count before the backend —
+            # the param/HBM saving is already banked in the projection; the
+            # repeat stays in registers/VMEM under XLA fusion.
+            rep = cfg.num_heads // cfg.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
 
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         out = _dense_general(
             cfg.embed_dim, (Logical.HEADS, Logical.EMBED), cfg, "out",
+            use_bias=cfg.use_bias,
         )(out)
         if cfg.dropout_rate > 0:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
@@ -235,19 +288,52 @@ class MlpBlock(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         deterministic = self.deterministic
-        h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
-                           "wi")(x)
+        if cfg.activation == "swiglu":
+            # Llama FFN: silu(x@W_gate) * (x@W_up), gate+up fused into one
+            # [embed, 2, ffn] kernel (same MXU-utilization rationale as the
+            # fused qkv projection); the stacked "2" dim is unsharded so
+            # "mlp"→tensor still splits clean columns.
+            kernel = self.param(
+                "wi_kernel",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    (Logical.EMBED, None, Logical.MLP)),
+                (cfg.embed_dim, 2, cfg.ffn_dim),
+                cfg.param_dtype,
+            )
+            gu = jnp.einsum("bse,ecf->bscf", x, kernel.astype(cfg.dtype))
+            if cfg.use_bias:
+                bias = self.param(
+                    "wi_bias",
+                    nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), (None, Logical.MLP)),
+                    (2, cfg.ffn_dim),
+                    cfg.param_dtype,
+                )
+                gu = gu + bias.astype(cfg.dtype)
+            h = nn.silu(gu[..., 0, :]) * gu[..., 1, :]
+        else:
+            h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
+                               "wi", use_bias=cfg.use_bias)(x)
+            h = nn.gelu(h)
         h = nn.with_logical_constraint(
             h, (Logical.BATCH, Logical.SEQ, Logical.MLP))
-        h = nn.gelu(h)
         out = _dense_general(cfg.embed_dim, (Logical.MLP, Logical.EMBED), cfg,
-                             "wo")(h)
+                             "wo", use_bias=cfg.use_bias)(h)
         if cfg.dropout_rate > 0:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
         return out
 
 
 def _layer_norm(cfg, name):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), (Logical.EMBED,)),
+            name=name,
+        )
     return nn.LayerNorm(
         dtype=jnp.float32,  # normalize in fp32 regardless of compute dtype
         param_dtype=cfg.param_dtype,
@@ -257,6 +343,27 @@ def _layer_norm(cfg, name):
             nn.initializers.zeros_init(), (Logical.EMBED,)),
         name=name,
     )
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float,
+                dtype=jnp.float32):
+    """(cos, sin) tables ``[seq, head_dim/2]`` for rotary embeddings."""
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                      / head_dim)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate ``x [b, s, h, d]`` by per-position angles (split-halves
+    convention: pair dim i with dim i+d/2 — same rotation group as the
+    interleaved convention, chosen because it lowers to two slices instead
+    of a strided gather)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
 class TransformerBlock(nn.Module):
@@ -354,6 +461,28 @@ class TransformerStack(nn.Module):
                           remat=cfg.remat, remat_policy=cfg.remat_policy)
 
 
+class LMHead(nn.Module):
+    """Untied logit projection, setup-style so the kernel is an attribute —
+    the fused chunked-CE loss path (ops/fused_ce.py) reads it directly
+    instead of materializing logits. Param tree: ``lm_head/kernel``."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02),
+                (Logical.EMBED, Logical.VOCAB)),
+            (cfg.embed_dim, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+
+    def __call__(self, x):
+        return x.astype(self.cfg.dtype) @ self.kernel.astype(self.cfg.dtype)
+
+
 class Embedder(nn.Module):
     """Token + learned positional embeddings; `attend` gives the tied logit
     projection (GPT-2 weight tying)."""
@@ -370,17 +499,21 @@ class Embedder(nn.Module):
                 (Logical.VOCAB, Logical.EMBED)),
             name="tok",
         )
-        self.pos = self.param(
-            "pos",
-            nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), (None, Logical.EMBED)),
-            (cfg.max_seq_len, cfg.embed_dim),
-            cfg.param_dtype,
-        )
+        if not cfg.rope:  # RoPE models carry position in q/k rotation
+            self.pos = self.param(
+                "pos",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    (None, Logical.EMBED)),
+                (cfg.max_seq_len, cfg.embed_dim),
+                cfg.param_dtype,
+            )
 
     def __call__(self, tokens):
         seq_len = tokens.shape[1]
         x = self.tok(tokens)
+        if self.cfg.rope:
+            return x
         return x + self.pos[:seq_len].astype(self.cfg.dtype)
 
     def attend(self, x):

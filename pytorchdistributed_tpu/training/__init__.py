@@ -1,6 +1,7 @@
 from pytorchdistributed_tpu.training.trainer import Trainer, TrainState  # noqa: F401
 from pytorchdistributed_tpu.training.losses import (  # noqa: F401
     cross_entropy_loss,
+    fused_token_cross_entropy_loss,
     moe_token_cross_entropy_loss,
     mse_loss,
     token_cross_entropy_loss,

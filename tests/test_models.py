@@ -127,6 +127,35 @@ def test_resnet18_cifar_smoke():
     assert float(m["loss"]) < l0
 
 
+def test_fused_ce_loss_matches_unfused():
+    """The chunked fused-CE head (ops/fused_ce.py via loss_per_position)
+    must reproduce the materialized-logits loss AND its gradients — it is a
+    memory-layout optimization, not a different objective."""
+    from pytorchdistributed_tpu.models import Llama, llama_config
+    from pytorchdistributed_tpu.training import fused_token_cross_entropy_loss
+    from pytorchdistributed_tpu.training.losses import (
+        token_cross_entropy_loss as unfused,
+    )
+
+    rng = np.random.default_rng(4)
+    batch = _token_batch(rng, batch=2, seq=16)
+    for model in (GPT2(gpt2_config("test", dtype=np.float32)),
+                  Llama(llama_config("test", dtype=np.float32))):
+        params = model.init(jax.random.key(0), batch["tokens"])
+
+        def fused(p):
+            return fused_token_cross_entropy_loss(model, p, batch)[0]
+
+        def dense(p):
+            return unfused(model, p, batch)[0]
+
+        lf, gf = jax.value_and_grad(fused)(params)
+        ld, gd = jax.value_and_grad(dense)(params)
+        np.testing.assert_allclose(float(lf), float(ld), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gd)):
+            np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
+
+
 def test_scan_vs_unrolled_same_shape():
     """scan_layers is a compile-time optimization, not a semantic change."""
     rng = np.random.default_rng(0)
