@@ -47,3 +47,4 @@ from pytorchdistributed_tpu.runtime.dist import (  # noqa: F401
     get_world_size,
     is_initialized,
 )
+from pytorchdistributed_tpu.inference import generate  # noqa: F401

@@ -61,6 +61,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     num_kv_heads: int | None = None     # < num_heads = grouped-query attn
     use_bias: bool = True               # Llama: no biases anywhere
+    # Autoregressive decode mode (inference.generate): attention keeps a
+    # [b, max_seq_len, kv_heads, head_dim] K/V cache in the flax "cache"
+    # collection and attends over it with a position mask; the embedder
+    # tracks its own position counter. Same params as decode=False.
+    decode: bool = False
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -98,6 +103,9 @@ class TransformerConfig:
             raise ValueError(
                 f"num_kv_heads {kv} must be a positive divisor of "
                 f"num_heads {self.num_heads}")
+        if self.decode and self.pipeline_stages > 1:
+            raise ValueError("decode mode does not compose with pipeline "
+                             "parallelism (generate on a dp/tp mesh instead)")
 
     @property
     def kv_heads(self) -> int:
@@ -254,18 +262,61 @@ class SelfAttention(nn.Module):
             k = heads(kv[..., 0, :], cfg.kv_heads)
             v = heads(kv[..., 1, :], cfg.kv_heads)
 
+        if cfg.decode:
+            idx_var = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32))
+            idx = idx_var.value
         if cfg.rope:
-            cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
+            cos, sin = rope_tables(cfg.max_seq_len, cfg.head_dim,
+                                   cfg.rope_theta)
+            if cfg.decode:
+                cos = jax.lax.dynamic_slice_in_dim(cos, idx, s)
+                sin = jax.lax.dynamic_slice_in_dim(sin, idx, s)
+            else:
+                cos, sin = cos[:s], sin[:s]
             q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        if cfg.kv_heads != cfg.num_heads:
-            # Broadcast KV groups to full head count before the backend —
-            # the param/HBM saving is already banked in the projection; the
-            # repeat stays in registers/VMEM under XLA fusion.
-            rep = cfg.num_heads // cfg.kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
 
-        out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
+        rep = cfg.num_heads // cfg.kv_heads
+
+        if cfg.decode:
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            if not self.is_initializing():
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                idx_var.value = idx + s
+            kc, vc = cached_k.value, cached_v.value
+            if rep > 1:
+                kc = jnp.repeat(kc, rep, axis=2)
+                vc = jnp.repeat(vc, rep, axis=2)
+            # Masked dense attention over the whole cache: the current
+            # chunk's token i (absolute position idx+i) sees cache slots
+            # j <= idx+i. fp32 softmax like the training backends.
+            pos = idx + jnp.arange(s)
+            valid = jnp.arange(cfg.max_seq_len)[None, :] <= pos[:, None]
+            scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
+                                preferred_element_type=jnp.float32)
+            scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+            scores = jnp.where(valid[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhij,bjhd->bihd", probs.astype(cfg.dtype), vc,
+                             preferred_element_type=jnp.float32
+                             ).astype(cfg.dtype)
+        else:
+            if rep > 1:
+                # Broadcast KV groups to full head count before the
+                # backend — the param/HBM saving is already banked in the
+                # projection; the repeat stays in registers/VMEM under XLA
+                # fusion.
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
 
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         out = _dense_general(
@@ -413,7 +464,7 @@ class TransformerStack(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry), None),
-                variable_axes={"params": 0, "losses": 0},
+                variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: Logical.STAGE},
@@ -508,12 +559,21 @@ class Embedder(nn.Module):
                 (cfg.max_seq_len, cfg.embed_dim),
                 cfg.param_dtype,
             )
+            if cfg.decode:
+                self.pos_index = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32))
 
     def __call__(self, tokens):
         seq_len = tokens.shape[1]
         x = self.tok(tokens)
         if self.cfg.rope:
             return x
+        if self.cfg.decode:
+            p = jax.lax.dynamic_slice_in_dim(
+                self.pos, self.pos_index.value, seq_len)
+            if not self.is_initializing():
+                self.pos_index.value = self.pos_index.value + seq_len
+            return x + p.astype(self.cfg.dtype)
         return x + self.pos[:seq_len].astype(self.cfg.dtype)
 
     def attend(self, x):

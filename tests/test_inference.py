@@ -1,0 +1,123 @@
+"""KV-cache generation tests (inference.generate).
+
+Correctness bar: the cached decode path must reproduce the no-cache model
+exactly — greedy generation is checked token-by-token against argmax of a
+full decode=False forward pass over the generated sequence (this catches
+cache indexing, RoPE offsets, learned-position offsets, GQA cache layout,
+and mask bugs all at once)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import (
+    GPT2,
+    Llama,
+    gpt2_config,
+    llama_config,
+)
+
+
+def _greedy_consistency(train_model, decode_model, vocab):
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, vocab, (2, 5)), jnp.int32)
+    params = train_model.init(jax.random.key(1), prompt)
+
+    out = generate(decode_model, params, prompt, max_new_tokens=8,
+                   temperature=0.0)
+    assert out.shape == (2, 13)
+    np.testing.assert_array_equal(out[:, :5], prompt)
+
+    # dense re-check: feeding the generated sequence through the normal
+    # (uncached) model, every generated token must be the argmax of the
+    # logits one position earlier
+    logits = train_model.apply(params, out)
+    want = jnp.argmax(logits[:, 4:-1].astype(jnp.float32), axis=-1)
+    np.testing.assert_array_equal(out[:, 5:], want)
+
+
+def test_gpt2_greedy_matches_dense():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32)
+    _greedy_consistency(GPT2(cfg), GPT2(dataclasses.replace(cfg, decode=True)),
+                        cfg.vocab_size)
+
+
+def test_llama_greedy_matches_dense():
+    """RoPE offsets + GQA cache layout under decode."""
+    cfg = llama_config("test", max_seq_len=32)
+    _greedy_consistency(Llama(cfg),
+                        Llama(dataclasses.replace(cfg, decode=True)),
+                        cfg.vocab_size)
+
+
+def test_gpt2_unrolled_layers_decode():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, scan_layers=False)
+    _greedy_consistency(GPT2(cfg), GPT2(dataclasses.replace(cfg, decode=True)),
+                        cfg.vocab_size)
+
+
+def test_sampling_deterministic_and_in_range():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    kw = dict(max_new_tokens=6, temperature=0.8, top_k=10)
+    a = generate(model, params, prompt, rng=jax.random.key(7), **kw)
+    b = generate(model, params, prompt, rng=jax.random.key(7), **kw)
+    c = generate(model, params, prompt, rng=jax.random.key(8), **kw)
+    np.testing.assert_array_equal(a, b)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()
+    # different keys must change the sampled continuation (fixed seeds —
+    # deterministic; a regression that ignores rng would make these equal)
+    assert not np.array_equal(a, c)
+
+
+def test_eos_freezes_rows():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    first = generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    eos = int(first[0, 4])  # whatever greedy emits first becomes "eos"
+    out = generate(model, params, prompt, max_new_tokens=8, temperature=0.0,
+                   eos_id=eos)
+    assert (np.asarray(out[0, 4:]) == eos).all()
+
+
+def test_eos_in_prompt_is_inert():
+    """A prompt that happens to contain eos_id must pass through intact —
+    prefill is not sampling, so it can't trip the done latch."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 6)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    eos = int(prompt[0, 2])  # mid-prompt token doubles as eos
+    out = generate(model, params, prompt, max_new_tokens=4, temperature=0.0,
+                   eos_id=eos)
+    np.testing.assert_array_equal(out[:, :6], prompt)
+    ref = generate(model, params, prompt, max_new_tokens=4, temperature=0.0)
+    # generation proceeds identically until (if ever) eos is emitted
+    gen, ref_gen = np.asarray(out[0, 6:]), np.asarray(ref[0, 6:])
+    stop = np.argmax(ref_gen == eos) if (ref_gen == eos).any() else len(ref_gen)
+    np.testing.assert_array_equal(gen[:stop], ref_gen[:stop])
+
+
+def test_generate_validations():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=8)
+    model = GPT2(cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)
+    with pytest.raises(ValueError, match="decode"):
+        generate(model, params, prompt, max_new_tokens=2)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(dm, params, prompt, max_new_tokens=100)
+    with pytest.raises(ValueError, match="pipeline"):
+        gpt2_config("test", decode=True, pipeline_stages=2)
