@@ -30,6 +30,7 @@ import numpy as np
 # purpose — a file the bench writes itself can never look slow.
 COMMITTED_BASELINES = {
     "gpt2s_train_tokens_per_s": 43381.7,   # BENCH_r01.json
+    "llama1b_train_tokens_per_s": 14457.3,  # round-2 first measurement
     "resnet50_train_img_per_s": 2058.6,    # round-1 bench_baseline.json
     "pp_sweep_best_tokens_per_s": 4138.0,  # round-1 bench_baseline.json
 }

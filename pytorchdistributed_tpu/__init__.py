@@ -8,7 +8,10 @@ and gradient all-reduce over ICI, tensor/model sharding, micro-batched pipeline
 parallelism (GPipe and 1F1B schedules), FSDP-style parameter+optimizer sharding
 with bf16 and activation checkpointing, sequence/context parallelism (ring
 attention, Ulysses) for long context, Switch-MoE expert parallelism over the
-expert axis, and memory-budgeted auto placement (the device_map="auto" analog).
+expert axis, memory-budgeted auto placement (the device_map="auto" analog),
+a model zoo (GPT-2, Llama with RoPE/SwiGLU/GQA, BERT, ViT, ResNet) on one
+shared Transformer core, and KV-cache autoregressive generation
+(inference.generate).
 
 Design stance (SURVEY.md §7): the reference's wrapper classes
 (DataParallel/DDP, reference ddp_gpus.py:35) become *sharding-spec choices over

@@ -78,6 +78,8 @@ def generate(
             "generate() needs a decode-mode model: build it with "
             "TransformerConfig(decode=True) / *_config(..., decode=True)")
     b, prompt_len = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(

@@ -47,6 +47,21 @@ implemented: they exist to hide gradient staleness in an *asynchronous*
 pipeline, while this schedule is synchronous within one optimizer step — the
 flush variant (PipeDream-flush ≙ non-interleaved 1F1B, :697), which has no
 staleness to hide.
+
+Megatron's interleaved-1F1B / virtual-pipeline (reference :699-705) is also
+deliberately not implemented, after working the schedule out in this SPMD
+formulation. Its bubble win divides the (P-1)-deep warmup/cooldown by the
+virtual-stage count V — but that win exists only because each GPU runs its
+own *asynchronous* F/B slot sequence over p2p sends, skipping idle slots.
+In a `shard_map` + `lax.scan` pipeline every tick is a collective step all
+devices execute in lockstep: a per-device F-or-B choice needs non-uniform
+control flow around the ppermutes (illegal in SPMD), and masking both slots
+per tick pays both slots' compute whether used or not. Worked example
+(P=2, V=2, M=4, B=2F): the lockstep interleaved schedule and the lockstep
+non-interleaved one waste exactly the same 8 chunk-slots — V cancels out.
+The honest TPU answers to the bubble are the ones implemented: raise M (the
+reference's own split-size sweep, bubble (P-1)/(M+P-1)) and keep P shallow
+by preferring fsdp/tensor axes (parallel/auto.py plans in that order).
 """
 
 from __future__ import annotations

@@ -124,6 +124,16 @@ class Trainer:
         self.state: TrainState | None = None
         self.state_shardings = None
         self._step_fn = None
+        # XLA:CPU's in-process collective rendezvous deadlocks when too many
+        # multi-device programs sit in the async dispatch queue (observed at
+        # ~100 queued 8-device all-reduce steps on the CPU sim). Real jobs
+        # force device values at log cadence anyway; this backstop bounds
+        # the queue for callers that loop train_step without ever reading a
+        # metric. TPU is unaffected (0 = never force).
+        self._force_every = (
+            32 if jax.default_backend() == "cpu"
+            and self.mesh.devices.size > 1 else 0)
+        self._unforced = 0
         # Rank-aware per-leaf batch layout: leading dim over the data axes;
         # 2-D token leaves also over "seq" when the mesh has a
         # context-parallel axis (ring/ulysses attention read seq-sharded
@@ -330,6 +340,11 @@ class Trainer:
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
             self.state, metrics = self._step_fn(self.state, batch)
+        if self._force_every:
+            self._unforced += 1
+            if self._unforced >= self._force_every:
+                jax.block_until_ready(metrics)
+                self._unforced = 0
         return metrics
 
     # -- epochs ------------------------------------------------------------

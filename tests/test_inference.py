@@ -119,5 +119,7 @@ def test_generate_validations():
     dm = GPT2(dataclasses.replace(cfg, decode=True))
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(dm, params, prompt, max_new_tokens=100)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(dm, params, prompt, max_new_tokens=0)
     with pytest.raises(ValueError, match="pipeline"):
         gpt2_config("test", decode=True, pipeline_stages=2)
