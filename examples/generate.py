@@ -2,9 +2,9 @@
 inference ambition (the llama-7b `device_map="auto"` cell,
 03_model_parallel.ipynb:86-89, which never ran).
 
-Trains a tiny Llama on a synthetic copy task (predict the previous token),
-then samples continuations with the KV-cache decode loop to show the learned
-behavior. Run anywhere:
+Trains a tiny Llama on a synthetic identity task (predict the current
+token), then samples continuations with the KV-cache decode loop — greedy
+generation visibly repeats the prompt's last token, the learned behavior. Run anywhere:
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/generate.py --steps 200

@@ -36,6 +36,10 @@ class ExperimentConfig:
     seq: int = 1
     num_slices: int = 1
     pipeline_microbatches: int = 1
+    # Gradient accumulation: split each global batch into this many
+    # micro-batches inside the jitted step (fp32 grad sum, one optimizer
+    # update) — the large-batch recipe when activations exceed HBM.
+    accum_steps: int = 1
     pp_schedule: str = "gpipe"     # gpipe | 1f1b (transformer models)
     expert: int = 1                # mesh axis for expert parallelism
     moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
@@ -45,7 +49,8 @@ class ExperimentConfig:
     max_epochs: int = 1
     batch_size: int = 32           # per-process
     learning_rate: float = 1e-3
-    optimizer: str = "adamw"       # adamw | sgd
+    optimizer: str = "adamw"       # adamw | sgd | adafactor
+    weight_decay: float = 0.01     # adamw decay, masked to ndim>=2 params
     # LR schedule: peak = learning_rate, linear warmup over warmup_steps,
     # then constant / cosine / linear decay to lr_end over decay_steps.
     lr_schedule: str = "constant"  # constant | cosine | linear
@@ -200,8 +205,7 @@ def _build_model(cfg: ExperimentConfig):
         cls, make_cfg = lm_families[cfg.model]
         model = cls(make_cfg(cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
         loss = token_cross_entropy_loss
-        ds = SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
-                                   model.cfg.vocab_size, cfg.seed)
+        ds = _token_dataset(cfg, model.cfg.vocab_size)
     elif cfg.model == "vit":
         model = models.ViT(models.vit_config(
             cfg.model_size, image_size=cfg.image_size,
@@ -247,6 +251,29 @@ def _image_dataset(cfg: ExperimentConfig):
               f"falling back to synthetic data", flush=True)
     return SyntheticImageDataset(cfg.dataset_size, cfg.image_size,
                                  num_classes=cfg.num_classes, seed=cfg.seed)
+
+
+def _token_dataset(cfg: ExperimentConfig, vocab_size: int):
+    """Real pre-tokenized corpus when --data_dir holds a
+    ``{split}_tokens.npy`` (1-D stream or [n, seq+1] windows, memory-mapped
+    through the native gather), synthetic fallback otherwise — the LM
+    analog of _image_dataset."""
+    from pytorchdistributed_tpu.data import SyntheticTokenDataset
+    from pytorchdistributed_tpu.data.files import load_tokens
+
+    if cfg.data_dir:
+        ds = load_tokens(cfg.data_dir, cfg.seq_len)
+        if ds is not None:
+            if ds.vocab_size > vocab_size:
+                raise ValueError(
+                    f"--data_dir corpus has token ids up to "
+                    f"{ds.vocab_size - 1} but the model's vocab is "
+                    f"{vocab_size}")
+            return ds
+        print(f"[config] no {{split}}_tokens.npy under {cfg.data_dir!r}; "
+              f"falling back to synthetic data", flush=True)
+    return SyntheticTokenDataset(cfg.dataset_size, cfg.seq_len,
+                                 vocab_size, cfg.seed)
 
 
 def build(cfg: ExperimentConfig):
@@ -314,15 +341,32 @@ def make_lr_schedule(cfg: ExperimentConfig):
                      "(constant | cosine | linear)")
 
 
+def decay_mask(params):
+    """Standard transformer weight-decay mask: decay matrices (kernels and
+    embedding tables, ndim >= 2), never biases or norm scales (ndim <= 1) —
+    decaying norm scales toward zero actively hurts. Shape-based so it
+    works for every model family without name lists."""
+    import jax
+
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
 def make_optimizer(cfg: ExperimentConfig):
-    """Optimizer chain: [global-norm clip →] adamw/sgd with the schedule."""
+    """Optimizer chain: [global-norm clip →] adamw/sgd/adafactor with the
+    schedule; adamw's weight decay is masked to matrices only."""
     import optax
 
     lr = make_lr_schedule(cfg)
     if cfg.optimizer == "adamw":
-        opt = optax.adamw(lr)
+        opt = optax.adamw(lr, weight_decay=cfg.weight_decay,
+                          mask=decay_mask)
     elif cfg.optimizer == "sgd":
         opt = optax.sgd(lr, momentum=0.9)
+    elif cfg.optimizer == "adafactor":
+        # the memory-factored choice: second moment stored as row/col
+        # factors — what lets 1B+ models train on one 16G chip (bench.py
+        # llama1b)
+        opt = optax.adafactor(lr)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.grad_clip_norm > 0:
@@ -346,5 +390,6 @@ def make_trainer(cfg: ExperimentConfig):
         checkpoint_every_steps=cfg.checkpoint_every_steps,
         watchdog=cfg.watchdog,
         profile_dir=cfg.profile_dir or None,
+        accum_steps=cfg.accum_steps,
     )
     return trainer, loader

@@ -8,6 +8,8 @@ from pytorchdistributed_tpu.data.datasets import (  # noqa: F401
 )
 from pytorchdistributed_tpu.data.files import (  # noqa: F401
     MappedImageDataset,
+    MappedTokenDataset,
     load_cifar10,
     load_image_dir,
+    load_tokens,
 )

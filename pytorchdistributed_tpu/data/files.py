@@ -96,3 +96,79 @@ def load_image_dir(root: str | pathlib.Path,
     if not (root / f"{split}_images.npy").exists():
         return None
     return MappedImageDataset(root, split)
+
+
+class MappedTokenDataset(ArrayDataset):
+    """Memory-mapped pre-tokenized LM corpus: ``<root>/<split>_tokens.npy``,
+    either a 1-D token stream (windowed into non-overlapping ``seq_len+1``
+    chunks, causal next-token targets) or an already-windowed 2-D
+    ``[n, >=seq_len+1]`` array.
+
+    The gather fetches whole contiguous rows (a column-sliced mmap view
+    would silently bypass the native multithreaded gather, which requires
+    C-contiguous sources); tokens/targets are sliced from the gathered
+    batch. Token-id bounds (the vocab check, and a negative-id guard — a
+    ``-1``-padded corpus would otherwise wrap through the embedding/CE
+    gathers into finite-but-wrong losses) are scanned ONCE and cached in a
+    ``<split>_tokens.meta.json`` sidecar, so steady-state construction
+    touches no corpus pages."""
+
+    def __init__(self, root: str | pathlib.Path, seq_len: int,
+                 split: str = "train"):
+        root = pathlib.Path(root)
+        path = root / f"{split}_tokens.npy"
+        arr = np.load(path, mmap_mode="r")
+        if arr.ndim == 1:
+            n = arr.shape[0] // (seq_len + 1)
+            if n == 0:
+                raise ValueError(
+                    f"{split}_tokens.npy holds {arr.shape[0]} tokens — "
+                    f"fewer than one seq_len+1={seq_len + 1} window")
+            arr = arr[: n * (seq_len + 1)].reshape(n, seq_len + 1)
+        elif arr.shape[1] < seq_len + 1:
+            raise ValueError(
+                f"{split}_tokens.npy rows have {arr.shape[1]} tokens; "
+                f"need seq_len+1={seq_len + 1}")
+        lo, hi = self._token_bounds(path, arr)
+        if lo < 0:
+            raise ValueError(
+                f"{split}_tokens.npy contains negative token ids "
+                f"(min {lo}); pad/ignore ids must be remapped before "
+                f"training")
+        self.vocab_size = hi + 1
+        self._seq_len = seq_len
+        super().__init__({"chunk": arr})
+
+    @staticmethod
+    def _token_bounds(path: pathlib.Path, arr) -> tuple[int, int]:
+        import json
+
+        meta = path.with_name(path.stem + ".meta.json")
+        st = path.stat()
+        key = {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
+        if meta.exists():
+            cached = json.loads(meta.read_text())
+            if all(cached.get(k) == v for k, v in key.items()):
+                return cached["min"], cached["max"]
+        lo, hi = int(arr.min()), int(arr.max())
+        try:  # best-effort cache; a read-only data dir just rescans
+            meta.write_text(json.dumps({**key, "min": lo, "max": hi}))
+        except OSError:
+            pass
+        return lo, hi
+
+    def __getitem__(self, idx):
+        chunk = super().__getitem__(idx)["chunk"]
+        s = self._seq_len
+        return {"tokens": np.asarray(chunk[:, :s], np.int32),
+                "targets": np.asarray(chunk[:, 1:s + 1], np.int32)}
+
+
+def load_tokens(root: str | pathlib.Path, seq_len: int,
+                split: str = "train") -> MappedTokenDataset | None:
+    """``<root>/<split>_tokens.npy`` when present, else None — the LM
+    analog of load_image_dir (GPT-2/Llama/BERT presets with --data_dir)."""
+    root = pathlib.Path(root)
+    if not (root / f"{split}_tokens.npy").exists():
+        return None
+    return MappedTokenDataset(root, seq_len, split)

@@ -90,6 +90,7 @@ class Trainer:
         watchdog: bool = True,
         profile_dir: str | None = None,
         batch_adapter: Callable | None = None,
+        accum_steps: int = 1,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -97,6 +98,9 @@ class Trainer:
         self.strategy = strategy
         self.precision = precision or Policy.full()
         self.remat = remat
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
         self.log_every = log_every
         from pytorchdistributed_tpu.parallel.tp import logical_rules
         self._rules = logical_rules(strategy)
@@ -217,22 +221,58 @@ class Trainer:
         if self.remat:
             loss_fn = jax.checkpoint(loss_fn, static_argnums=(0,))
 
+        accum = self.accum_steps
+
         def step(state: TrainState, batch):
             # Derive the per-step rng on device from state.step — a host-side
             # int(state.step) here would block on the previous step and
             # serialize the hot loop, defeating the prefetcher's overlap.
             rng = jax.random.fold_in(jax.random.key(1_234_567), state.step)
 
-            def compute_loss(params):
+            def compute_loss(params, mb, mb_rng):
                 cparams = policy.cast_params_for_compute(params)
-                cbatch = policy.cast_batch(batch)
+                cbatch = policy.cast_batch(mb)
                 with nn.logical_axis_rules(self._rules):
-                    loss, metrics = loss_fn(self.model, cparams, cbatch, rng)
+                    loss, metrics = loss_fn(self.model, cparams, cbatch,
+                                            mb_rng)
                 return loss.astype(jnp.float32), metrics
 
-            (_, metrics), grads = jax.value_and_grad(
-                compute_loss, has_aux=True
-            )(state.params)
+            if accum == 1:
+                (_, metrics), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True
+                )(state.params, batch, rng)
+            else:
+                # Gradient accumulation: lax.scan over accum micro-batches
+                # INSIDE the jitted step (one compiled program, activations
+                # for one micro-batch alive at a time), fp32-accumulated
+                # grads averaged before the single optimizer update — the
+                # large-batch recipe when the full batch's activations
+                # exceed HBM.
+                def as_microbatches(leaf):
+                    b = leaf.shape[0]
+                    if b % accum:
+                        raise ValueError(
+                            f"global batch {b} not divisible by "
+                            f"accum_steps {accum}")
+                    return leaf.reshape(accum, b // accum, *leaf.shape[1:])
+
+                mbs = jax.tree.map(as_microbatches, batch)
+
+                def body(g_acc, mb_i):
+                    mb, i = mb_i
+                    (_, metrics), g = jax.value_and_grad(
+                        compute_loss, has_aux=True
+                    )(state.params, mb, jax.random.fold_in(rng, i))
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+                    return g_acc, metrics
+
+                g0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                grads, metrics = jax.lax.scan(
+                    body, g0, (mbs, jnp.arange(accum)))
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                metrics = jax.tree.map(lambda m: m.mean(), metrics)
             # Grads arrive in compute dtype; master update stays fp32.
             grads = jax.tree.map(
                 lambda g, p: g.astype(p.dtype), grads, state.params

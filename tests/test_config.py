@@ -103,3 +103,43 @@ def test_preset_trains_with_warmup():
     # step 0 ran at lr≈0 (warmup), so the same batch's loss barely moves
     assert abs(l1 - l0) < 1e-3
     assert np.isfinite(l1)
+
+
+def test_decay_mask_excludes_vectors():
+    import jax
+
+    from pytorchdistributed_tpu.config import decay_mask
+
+    params = {"dense": {"kernel": np.zeros((4, 4)), "bias": np.zeros((4,))},
+              "ln": {"scale": np.zeros((4,))},
+              "embed": {"embedding": np.zeros((10, 4))}}
+    mask = decay_mask(params)
+    assert mask["dense"]["kernel"] and mask["embed"]["embedding"]
+    assert not mask["dense"]["bias"] and not mask["ln"]["scale"]
+    assert jax.tree.structure(mask) == jax.tree.structure(params)
+
+
+def test_adafactor_optimizer_builds_and_steps():
+    from pytorchdistributed_tpu.config import ExperimentConfig, make_trainer
+
+    cfg = ExperimentConfig(model="mlp", optimizer="adafactor",
+                           learning_rate=1e-3, batch_size=16,
+                           dataset_size=64, backend="auto")
+    trainer, loader = make_trainer(cfg)
+    batch = next(iter(loader))
+    assert np.isfinite(float(trainer.train_step(batch)["loss"]))
+
+
+def test_masked_adamw_trains_via_preset():
+    from pytorchdistributed_tpu.config import parse_cli, make_trainer
+
+    cfg = parse_cli(["--model", "gpt2", "--model_size", "test",
+                     "--seq_len", "32", "--batch_size", "8",
+                     "--weight_decay", "0.1", "--backend", "auto",
+                     "--dataset_size", "64"])
+    trainer, loader = make_trainer(cfg)
+    batch = next(iter(loader))
+    l0 = float(trainer.train_step(batch)["loss"])
+    for _ in range(2):
+        m = trainer.train_step(batch)
+    assert np.isfinite(float(m["loss"])) and float(m["loss"]) < l0

@@ -210,3 +210,70 @@ def test_preset_trains_on_real_cifar(tmp_path):
     assert isinstance(loader.dataset, MappedImageDataset)
     batch = next(iter(loader))
     assert np.isfinite(float(trainer.train_step(batch)["loss"]))
+
+
+def test_mapped_token_dataset_windows_stream(tmp_path):
+    from pytorchdistributed_tpu.data import MappedTokenDataset, load_tokens
+
+    rng = np.random.default_rng(2)
+    stream = rng.integers(0, 500, (1000,), dtype=np.int32)
+    np.save(tmp_path / "train_tokens.npy", stream)
+    ds = MappedTokenDataset(tmp_path, seq_len=32)
+    assert len(ds) == 1000 // 33
+    b = ds[np.asarray([0, 3])]
+    assert b["tokens"].shape == (2, 32) and b["tokens"].dtype == np.int32
+    # causal contract: targets are the next token of the same window
+    np.testing.assert_array_equal(b["tokens"][0], stream[:32])
+    np.testing.assert_array_equal(b["targets"][0], stream[1:33])
+    np.testing.assert_array_equal(b["tokens"][1], stream[3 * 33:3 * 33 + 32])
+    assert load_tokens(tmp_path, 32) is not None
+    assert load_tokens(tmp_path / "nope", 32) is None
+
+
+def test_mapped_token_dataset_2d_and_validation(tmp_path):
+    from pytorchdistributed_tpu.data import MappedTokenDataset
+
+    rng = np.random.default_rng(3)
+    np.save(tmp_path / "train_tokens.npy",
+            rng.integers(0, 99, (10, 17), dtype=np.int32))
+    ds = MappedTokenDataset(tmp_path, seq_len=16)
+    assert len(ds) == 10 and ds.vocab_size <= 99
+    with pytest.raises(ValueError, match="seq_len"):
+        MappedTokenDataset(tmp_path, seq_len=64)
+
+
+def test_lm_preset_trains_on_real_tokens(tmp_path):
+    """The gpt2 preset picks up a pre-tokenized corpus from --data_dir."""
+    from pytorchdistributed_tpu.config import parse_cli, make_trainer
+    from pytorchdistributed_tpu.data.files import MappedTokenDataset
+
+    rng = np.random.default_rng(4)
+    np.save(tmp_path / "train_tokens.npy",
+            rng.integers(0, 128, (40 * 65,), dtype=np.int32))
+    cfg = parse_cli(["--model", "gpt2", "--model_size", "test",
+                     "--seq_len", "64", "--data_dir", str(tmp_path),
+                     "--batch_size", "8", "--backend", "auto"])
+    trainer, loader = make_trainer(cfg)
+    assert isinstance(loader.dataset, MappedTokenDataset)
+    batch = next(iter(loader))
+    assert np.isfinite(float(trainer.train_step(batch)["loss"]))
+
+
+def test_token_dataset_rejects_negative_ids_and_caches_meta(tmp_path):
+    import json
+
+    from pytorchdistributed_tpu.data import MappedTokenDataset
+
+    arr = np.arange(-1, 65, dtype=np.int32)  # contains -1
+    np.save(tmp_path / "train_tokens.npy", arr)
+    with pytest.raises(ValueError, match="negative"):
+        MappedTokenDataset(tmp_path, seq_len=32)
+    np.save(tmp_path / "train_tokens.npy", np.abs(arr))
+    ds = MappedTokenDataset(tmp_path, seq_len=32)
+    meta = tmp_path / "train_tokens.meta.json"
+    assert meta.exists() and json.loads(meta.read_text())["max"] == 64
+    # stale sidecar (different shape) is ignored and rewritten
+    np.save(tmp_path / "train_tokens.npy",
+            np.arange(200, dtype=np.int32) % 7)
+    ds = MappedTokenDataset(tmp_path, seq_len=32)
+    assert ds.vocab_size == 7

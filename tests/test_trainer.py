@@ -188,3 +188,39 @@ def test_profile_flag_writes_trace(tmp_path):
     tr.fit(_make_loader(), max_epochs=1)
     traces = list(tmp_path.rglob("*"))
     assert any(p.is_file() for p in traces), "no trace files captured"
+
+
+def test_accum_steps_matches_large_batch():
+    """Gradient accumulation is a memory layout, not a different optimizer:
+    accum_steps=4 on a batch of 32 must reproduce the accum_steps=1 loss
+    curve exactly (fp32 grad averaging == the mean-loss gradient)."""
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import token_cross_entropy_loss
+
+    rng = np.random.default_rng(6)
+    batch = {
+        "tokens": rng.integers(0, 128, (32, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (32, 16)).astype(np.int32),
+    }
+    losses = {}
+    for accum in (1, 4):
+        model = GPT2(gpt2_config("test", dtype=np.float32))
+        tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(), strategy="dp", accum_steps=accum)
+        losses[accum] = [float(tr.train_step(batch)["loss"])
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5, atol=1e-6)
+
+
+def test_accum_steps_validations():
+    from pytorchdistributed_tpu.training import mse_loss as _mse
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        Trainer(LinearRegression(), optax.sgd(1e-2), _mse,
+                mesh=create_mesh(), accum_steps=0)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), _mse,
+                 mesh=create_mesh(), accum_steps=3)
+    batch = {"x": np.zeros((8, 20), np.float32),
+             "y": np.zeros((8, 1), np.float32)}
+    with pytest.raises(ValueError, match="divisible"):
+        tr.train_step(batch)
