@@ -118,6 +118,17 @@ class MappedTokenDataset(ArrayDataset):
         root = pathlib.Path(root)
         path = root / f"{split}_tokens.npy"
         arr = np.load(path, mmap_mode="r")
+        # Bounds come from the UN-windowed on-disk array: a 1-D stream is
+        # truncated to a seq_len multiple below, so seq_len-dependent bounds
+        # would let a cached scan from one seq_len skip tokens (e.g. a
+        # trailing -1 pad) that another seq_len exposes.
+        lo, hi = self._token_bounds(path, arr)
+        if lo < 0:
+            raise ValueError(
+                f"{split}_tokens.npy contains negative token ids "
+                f"(min {lo}); pad/ignore ids must be remapped before "
+                f"training")
+        self.vocab_size = hi + 1
         if arr.ndim == 1:
             n = arr.shape[0] // (seq_len + 1)
             if n == 0:
@@ -129,13 +140,6 @@ class MappedTokenDataset(ArrayDataset):
             raise ValueError(
                 f"{split}_tokens.npy rows have {arr.shape[1]} tokens; "
                 f"need seq_len+1={seq_len + 1}")
-        lo, hi = self._token_bounds(path, arr)
-        if lo < 0:
-            raise ValueError(
-                f"{split}_tokens.npy contains negative token ids "
-                f"(min {lo}); pad/ignore ids must be remapped before "
-                f"training")
-        self.vocab_size = hi + 1
         self._seq_len = seq_len
         super().__init__({"chunk": arr})
 
@@ -146,13 +150,18 @@ class MappedTokenDataset(ArrayDataset):
         meta = path.with_name(path.stem + ".meta.json")
         st = path.stat()
         key = {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
-        if meta.exists():
+        try:  # corrupt / mid-write sidecar (non-atomic writers) -> rescan
             cached = json.loads(meta.read_text())
             if all(cached.get(k) == v for k, v in key.items()):
                 return cached["min"], cached["max"]
+        except (OSError, ValueError, KeyError):
+            pass
         lo, hi = int(arr.min()), int(arr.max())
-        try:  # best-effort cache; a read-only data dir just rescans
-            meta.write_text(json.dumps({**key, "min": lo, "max": hi}))
+        try:  # best-effort cache via temp+rename (atomic for readers);
+            # a read-only data dir just rescans next time
+            tmp = meta.with_name(meta.name + ".tmp")
+            tmp.write_text(json.dumps({**key, "min": lo, "max": hi}))
+            tmp.replace(meta)
         except OSError:
             pass
         return lo, hi
