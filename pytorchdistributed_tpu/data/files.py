@@ -149,10 +149,13 @@ class MappedTokenDataset(ArrayDataset):
 
         meta = path.with_name(path.stem + ".meta.json")
         st = path.stat()
-        key = {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
-        try:  # corrupt / mid-write sidecar (non-atomic writers) -> rescan
+        # "v": 2 = bounds scanned over the UN-windowed array; older or
+        # unversioned sidecars (seq_len-dependent bounds) must rescan.
+        key = {"v": 2, "size": st.st_size, "mtime_ns": st.st_mtime_ns}
+        try:  # corrupt / mid-write / non-dict sidecar -> rescan
             cached = json.loads(meta.read_text())
-            if all(cached.get(k) == v for k, v in key.items()):
+            if (isinstance(cached, dict)
+                    and all(cached.get(k) == v for k, v in key.items())):
                 return cached["min"], cached["max"]
         except (OSError, ValueError, KeyError):
             pass

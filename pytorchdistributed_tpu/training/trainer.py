@@ -128,6 +128,7 @@ class Trainer:
         self.state: TrainState | None = None
         self.state_shardings = None
         self._step_fn = None
+        self._eval_fn = None
         # XLA:CPU's in-process collective rendezvous deadlocks when too many
         # multi-device programs sit in the async dispatch queue (observed at
         # ~100 queued 8-device all-reduce steps on the CPU sim). Real jobs
@@ -427,6 +428,54 @@ class Trainer:
         self._maybe_profile(epoch, -1)  # close an open capture at epoch end
         return {k: float(v) for k, v in metrics.items()}
 
+    # -- evaluation --------------------------------------------------------
+
+    def eval_step(self, batch) -> dict:
+        """Forward + loss with NO optimizer update (and no rng — dropout
+        off). Jitted and cached on first use; params stay whatever
+        train_step left them."""
+        if self.state is None:
+            self.init(batch)
+        if self._eval_fn is None:
+            policy = self.precision
+
+            def estep(params, batch):
+                cparams = policy.cast_params_for_compute(params)
+                cbatch = policy.cast_batch(batch)
+                with nn.logical_axis_rules(self._rules):
+                    _, metrics = self._loss_fn(self.model, cparams, cbatch,
+                                               None)
+                return {k: v.astype(jnp.float32) for k, v in metrics.items()}
+
+            self._eval_fn = jax.jit(estep)
+        if any(not isinstance(v, jax.Array) for v in batch.values()):
+            batch = shard_batch(batch, self.batch_sharding)
+        with jax.set_mesh(self.mesh):
+            return self._eval_fn(self.state.params, batch)
+
+    def evaluate(self, loader, *, epoch: int = 0) -> dict[str, float]:
+        """Mean metrics over a validation loader (sample-weighted across
+        ragged final batches). The reference has no eval loop at all; this
+        is the missing half of its Trainer."""
+        totals: dict = {}
+        count = 0
+        loader.set_epoch(epoch)
+        for batch in prefetch_to_device(iter(loader), self.batch_sharding):
+            n = self._batch_samples(batch)
+            metrics = self.eval_step(batch)
+            for k, v in metrics.items():
+                # device-side accumulation: a per-batch float() here would
+                # block the host each step and defeat the prefetch overlap
+                totals[k] = totals.get(k, 0.0) + v * n
+            count += n
+        if count == 0:
+            return {}
+        out = {k: float(v) / count for k, v in totals.items()}
+        if dist.is_main_process():
+            self.logger.info(
+                "eval | " + " ".join(f"{k}={v:.4g}" for k, v in out.items()))
+        return out
+
     @property
     def throughput(self) -> float:
         """samples/s over the recent window (compile step excluded)."""
@@ -468,11 +517,12 @@ class Trainer:
              ).write_text(json.dumps(meta))
 
     def fit(self, loader, max_epochs: int, *,
-            resume: bool = False) -> dict[str, float]:
+            resume: bool = False, val_loader=None) -> dict[str, float]:
         """The reference's ``train`` (ddp_gpus.py:53-55), plus
         checkpoint/resume (SURVEY.md §5): with a checkpoint_dir configured,
         every epoch end saves the sharded state async, and ``resume=True``
-        continues from the latest step."""
+        continues from the latest step. ``val_loader`` runs evaluate() at
+        every epoch end; its metrics land in the return dict as val_*."""
         start_epoch, skip = 0, 0
         if resume:
             if self.checkpoint is None:
@@ -492,6 +542,9 @@ class Trainer:
             t0 = time.perf_counter()
             metrics = self.run_epoch(
                 loader, epoch, skip_steps=skip if epoch == start_epoch else 0)
+            if val_loader is not None:
+                metrics.update({f"val_{k}": v for k, v in
+                                self.evaluate(val_loader, epoch=epoch).items()})
             if self.checkpoint is not None:
                 self._save_checkpoint(force=True)
             if dist.is_main_process():

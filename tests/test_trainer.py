@@ -224,3 +224,37 @@ def test_accum_steps_validations():
              "y": np.zeros((8, 1), np.float32)}
     with pytest.raises(ValueError, match="divisible"):
         tr.train_step(batch)
+
+
+def test_evaluate_matches_train_loss():
+    """eval_step computes the same loss the next train_step reports (before
+    its update), and evaluate() sample-weights ragged final batches."""
+    ds = SyntheticRegressionDataset(size=40, seed=3)
+    loader = DataLoader(ds, batch_size=16, num_replicas=1, rank=0)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), log_every=10**9)
+    batch = next(iter(loader))
+    tr.init(batch)
+    ev = float(tr.eval_step(batch)["loss"])
+    trn = float(tr.train_step(batch)["loss"])
+    np.testing.assert_allclose(ev, trn, rtol=1e-6)
+    out = tr.evaluate(loader)
+    assert set(out) == {"loss"} and np.isfinite(out["loss"])
+    # hand-computed sample-weighted mean over the same batches
+    want, n = 0.0, 0
+    loader.set_epoch(0)
+    for b in loader:
+        want += float(tr.eval_step(b)["loss"]) * b["x"].shape[0]
+        n += b["x"].shape[0]
+    np.testing.assert_allclose(out["loss"], want / n, rtol=1e-6)
+
+
+def test_fit_with_val_loader_reports_val_metrics():
+    ds = SyntheticRegressionDataset(size=64, seed=4)
+    val = DataLoader(SyntheticRegressionDataset(size=32, seed=5),
+                     batch_size=16, num_replicas=1, rank=0)
+    loader = DataLoader(ds, batch_size=16, num_replicas=1, rank=0)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(), log_every=10**9)
+    out = tr.fit(loader, max_epochs=2, val_loader=val)
+    assert "val_loss" in out and np.isfinite(out["val_loss"])
