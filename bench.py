@@ -188,13 +188,17 @@ def bench_resnet50() -> dict:
     import optax
 
     from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.parallel import Policy
     from pytorchdistributed_tpu.runtime.mesh import create_mesh
     from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
 
-    batch_size = 64
+    # bf16 compute + batch 256: measured sweep on v5e (BASELINE.md) —
+    # fp32/64 1877, bf16/64 2046, bf16/256 2308 (peak), bf16/512 2183.
+    batch_size = 256
     trainer = Trainer(resnet50(), optax.sgd(0.1, momentum=0.9),
                       cross_entropy_loss, mesh=create_mesh(),
-                      strategy="dp", log_every=10**9)
+                      strategy="dp", precision=Policy.bf16(),
+                      log_every=10**9)
     rng = np.random.default_rng(0)
     batch = {
         "image": rng.standard_normal(

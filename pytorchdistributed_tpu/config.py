@@ -40,6 +40,7 @@ class ExperimentConfig:
     # micro-batches inside the jitted step (fp32 grad sum, one optimizer
     # update) — the large-batch recipe when activations exceed HBM.
     accum_steps: int = 1
+    mlm_mask_rate: float = 0.15    # BERT dynamic-masking rate
     pp_schedule: str = "gpipe"     # gpipe | 1f1b (transformer models)
     expert: int = 1                # mesh axis for expert parallelism
     moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
@@ -205,7 +206,18 @@ def _build_model(cfg: ExperimentConfig):
         cls, make_cfg = lm_families[cfg.model]
         model = cls(make_cfg(cfg.model_size, max_seq_len=cfg.seq_len, **tkw))
         loss = token_cross_entropy_loss
-        ds = _token_dataset(cfg, model.cfg.vocab_size)
+        data_vocab = model.cfg.vocab_size - (cfg.model == "bert")
+        ds = _token_dataset(cfg, data_vocab)
+        if cfg.model == "bert":
+            # BERT trains the masked-LM objective, not next-token: wrap the
+            # corpus in dynamic 80/10/10 masking (data/datasets.MLMDataset).
+            # The top vocab id is RESERVED as [MASK]: the corpus (synthetic
+            # or --data_dir) is held to ids < vocab-1 so mask positions are
+            # unambiguous.
+            from pytorchdistributed_tpu.data import MLMDataset
+
+            ds = MLMDataset(ds, model.cfg.vocab_size,
+                            mask_rate=cfg.mlm_mask_rate, seed=cfg.seed)
     elif cfg.model == "vit":
         model = models.ViT(models.vit_config(
             cfg.model_size, image_size=cfg.image_size,

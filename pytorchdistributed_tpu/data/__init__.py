@@ -5,6 +5,7 @@ from pytorchdistributed_tpu.data.datasets import (  # noqa: F401
     SyntheticRegressionDataset,
     SyntheticImageDataset,
     SyntheticTokenDataset,
+    MLMDataset,
 )
 from pytorchdistributed_tpu.data.files import (  # noqa: F401
     MappedImageDataset,

@@ -88,3 +88,53 @@ class SyntheticTokenDataset(ArrayDataset):
             "tokens": toks[:, :-1],
             "targets": toks[:, 1:],
         })
+
+
+class MLMDataset:
+    """Dynamic masked-LM view over any token dataset (BERT's objective —
+    the reference never reaches it; BASELINE config[2] demands it).
+
+    Wraps a dataset yielding ``{"tokens", ...}`` and applies BERT's dynamic
+    masking per *fetch* (RoBERTa-style: a sample gets a fresh mask each
+    epoch, deterministic in (seed, indices)): of ``mask_rate`` selected
+    positions, 80% become ``mask_id`` (default: vocab_size-1, reserved by
+    convention), 10% a random token, 10% unchanged. Emits the BERT batch
+    contract: tokens (corrupted), targets (originals), loss_mask (selected
+    positions).
+    """
+
+    def __init__(self, base, vocab_size: int, *, mask_rate: float = 0.15,
+                 mask_id: int | None = None, seed: int = 0):
+        self.base = base
+        self.vocab_size = vocab_size
+        self.mask_rate = mask_rate
+        self.mask_id = vocab_size - 1 if mask_id is None else mask_id
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        batch = self.base[idx]
+        tokens = np.asarray(batch["tokens"], np.int32)
+        flat = np.atleast_1d(np.asarray(idx)).astype(np.int64)
+        # negatives index fine in the base; map them to their positive
+        # aliases for the rng entropy (SeedSequence rejects negatives, and
+        # ds[-1] must mask identically to ds[len-1])
+        flat = flat % max(len(self), 1)
+        rng = np.random.default_rng([self.seed, *flat.tolist()])
+        r = rng.random(tokens.shape)
+        selected = r < self.mask_rate
+        # split the selected mass 80/10/10 by where r falls inside it
+        to_mask = r < self.mask_rate * 0.8
+        to_rand = (r >= self.mask_rate * 0.8) & (r < self.mask_rate * 0.9)
+        corrupted = np.where(to_mask, self.mask_id, tokens)
+        # random replacements never emit mask_id (draw over vocab-1 ids,
+        # shift past the hole)
+        rand = rng.integers(0, self.vocab_size - 1, tokens.shape,
+                            dtype=np.int32)
+        rand = rand + (rand >= self.mask_id)
+        corrupted = np.where(to_rand, rand, corrupted)
+        return {"tokens": corrupted.astype(np.int32),
+                "targets": tokens,
+                "loss_mask": selected.astype(np.int32)}

@@ -381,12 +381,17 @@ class Trainer:
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
             self.state, metrics = self._step_fn(self.state, batch)
+        self._bound_dispatch_queue(metrics)
+        return metrics
+
+    def _bound_dispatch_queue(self, metrics) -> None:
+        """See _force_every: every multi-device dispatch on the CPU sim
+        counts against the queue bound, train and eval alike."""
         if self._force_every:
             self._unforced += 1
             if self._unforced >= self._force_every:
                 jax.block_until_ready(metrics)
                 self._unforced = 0
-        return metrics
 
     # -- epochs ------------------------------------------------------------
 
@@ -451,15 +456,21 @@ class Trainer:
         if any(not isinstance(v, jax.Array) for v in batch.values()):
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
-            return self._eval_fn(self.state.params, batch)
+            metrics = self._eval_fn(self.state.params, batch)
+        self._bound_dispatch_queue(metrics)
+        return metrics
 
-    def evaluate(self, loader, *, epoch: int = 0) -> dict[str, float]:
+    def evaluate(self, loader) -> dict[str, float]:
         """Mean metrics over a validation loader (sample-weighted across
-        ragged final batches). The reference has no eval loop at all; this
-        is the missing half of its Trainer."""
+        ragged final batches — build val loaders with drop_last=False so
+        every sample is scored). The epoch is pinned to 0 so successive
+        evaluate() calls score the SAME subset in the same order — val
+        curves stay comparable across epochs; prefer shuffle=False val
+        loaders. The reference has no eval loop at all; this is the
+        missing half of its Trainer."""
         totals: dict = {}
         count = 0
-        loader.set_epoch(epoch)
+        loader.set_epoch(0)
         for batch in prefetch_to_device(iter(loader), self.batch_sharding):
             n = self._batch_samples(batch)
             metrics = self.eval_step(batch)
@@ -544,7 +555,7 @@ class Trainer:
                 loader, epoch, skip_steps=skip if epoch == start_epoch else 0)
             if val_loader is not None:
                 metrics.update({f"val_{k}": v for k, v in
-                                self.evaluate(val_loader, epoch=epoch).items()})
+                                self.evaluate(val_loader).items()})
             if self.checkpoint is not None:
                 self._save_checkpoint(force=True)
             if dist.is_main_process():

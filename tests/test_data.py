@@ -277,3 +277,51 @@ def test_token_dataset_rejects_negative_ids_and_caches_meta(tmp_path):
             np.arange(200, dtype=np.int32) % 7)
     ds = MappedTokenDataset(tmp_path, seq_len=32)
     assert ds.vocab_size == 7
+
+
+def test_mlm_dataset_contract():
+    from pytorchdistributed_tpu.data import MLMDataset, SyntheticTokenDataset
+
+    base = SyntheticTokenDataset(size=64, seq_len=400, vocab_size=100, seed=1)
+    ds = MLMDataset(base, 100, mask_rate=0.15, seed=2)
+    assert len(ds) == 64
+    idx = np.arange(16)
+    b = ds[idx]
+    assert set(b) == {"tokens", "targets", "loss_mask"}
+    # targets are the ORIGINAL tokens; corruption only where masked
+    orig = base[idx]["tokens"]
+    np.testing.assert_array_equal(b["targets"], orig)
+    np.testing.assert_array_equal(
+        np.where(b["loss_mask"] == 0, b["tokens"], 0),
+        np.where(b["loss_mask"] == 0, orig, 0))
+    rate = b["loss_mask"].mean()
+    assert 0.12 < rate < 0.18  # ~15% of 6400 positions
+    # of selected positions, most become mask_id (80%), some random/kept
+    sel = b["loss_mask"].astype(bool)
+    frac_masked = (b["tokens"][sel] == ds.mask_id).mean()
+    assert 0.7 < frac_masked < 0.9
+    # deterministic in (seed, indices); different indices get new masks
+    again = ds[idx]
+    np.testing.assert_array_equal(b["tokens"], again["tokens"])
+    other = ds[np.arange(16, 32)]
+    assert other["loss_mask"].mean() > 0
+    # random replacements never emit the reserved mask id
+    rand_pos = (b["tokens"] != b["targets"]) & (b["tokens"] != ds.mask_id)
+    assert (b["tokens"][rand_pos] != ds.mask_id).all()
+    # negative indices alias their positive counterparts (numpy-style)
+    last = ds[len(ds) - 1]
+    np.testing.assert_array_equal(ds[-1]["tokens"], last["tokens"])
+
+
+def test_bert_preset_uses_mlm_masking():
+    from pytorchdistributed_tpu.config import parse_cli, make_trainer
+    from pytorchdistributed_tpu.data import MLMDataset
+
+    cfg = parse_cli(["--model", "bert", "--model_size", "test",
+                     "--seq_len", "64", "--batch_size", "8",
+                     "--backend", "auto", "--dataset_size", "64"])
+    trainer, loader = make_trainer(cfg)
+    assert isinstance(loader.dataset, MLMDataset)
+    batch = next(iter(loader))
+    assert "loss_mask" in batch and batch["loss_mask"].any()
+    assert np.isfinite(float(trainer.train_step(batch)["loss"]))
