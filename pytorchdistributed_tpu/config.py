@@ -277,10 +277,13 @@ def _token_dataset(cfg: ExperimentConfig, vocab_size: int):
         ds = load_tokens(cfg.data_dir, cfg.seq_len)
         if ds is not None:
             if ds.vocab_size > vocab_size:
+                hint = (" (the top id is reserved as [MASK] for BERT's "
+                        "dynamic masking — remap it in the corpus)"
+                        if cfg.model == "bert" else "")
                 raise ValueError(
                     f"--data_dir corpus has token ids up to "
-                    f"{ds.vocab_size - 1} but the model's vocab is "
-                    f"{vocab_size}")
+                    f"{ds.vocab_size - 1} but this config accepts data ids "
+                    f"< {vocab_size}{hint}")
             return ds
         print(f"[config] no {{split}}_tokens.npy under {cfg.data_dir!r}; "
               f"falling back to synthetic data", flush=True)

@@ -77,6 +77,27 @@ def test_sampling_deterministic_and_in_range():
     assert not np.array_equal(a, c)
 
 
+def test_top_p_sampling():
+    """Nucleus sampling: p→0 degenerates to greedy (only the max survives);
+    moderate p is deterministic per key and in-vocab."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(4)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    greedy = generate(model, params, prompt, max_new_tokens=6,
+                      temperature=0.0)
+    tiny_p = generate(model, params, prompt, max_new_tokens=6,
+                      temperature=0.7, top_p=1e-9, rng=jax.random.key(1))
+    np.testing.assert_array_equal(tiny_p, greedy)
+    a = generate(model, params, prompt, max_new_tokens=6, temperature=0.9,
+                 top_p=0.9, rng=jax.random.key(2))
+    b = generate(model, params, prompt, max_new_tokens=6, temperature=0.9,
+                 top_p=0.9, rng=jax.random.key(2))
+    np.testing.assert_array_equal(a, b)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()
+
+
 def test_eos_freezes_rows():
     cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
     model = GPT2(cfg)
