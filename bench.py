@@ -31,6 +31,7 @@ import numpy as np
 COMMITTED_BASELINES = {
     "gpt2s_train_tokens_per_s": 43381.7,   # BENCH_r01.json
     "llama1b_train_tokens_per_s": 14457.3,  # round-2 first measurement
+    "gpt2s_decode_tokens_per_s": 2738.8,    # round-2 (marginal-rate method)
     "resnet50_train_img_per_s": 2058.6,    # round-1 bench_baseline.json
     "pp_sweep_best_tokens_per_s": 4138.0,  # round-1 bench_baseline.json
 }
@@ -210,6 +211,42 @@ def bench_resnet50() -> dict:
             "value": round(batch_size / sec, 1), "unit": "img/s"}
 
 
+def bench_generate() -> dict:
+    """GPT-2-small KV-cache decode throughput, batch 4 with a 512-token
+    prompt. MARGINAL decode rate, prefill excluded: times 128-new-token
+    and 16-new-token runs (identical prefill) and divides the extra tokens
+    by the extra time — repeat-5 means each, matching the module's
+    repeat-and-mean methodology."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.inference import generate
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+
+    cfg = gpt2_config("small", scan_layers=False)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 50257, (4, 512)), jnp.int32)
+    params = jax.jit(GPT2(cfg).init)(jax.random.key(0), prompt[:, :64])
+    model = GPT2(dataclasses.replace(cfg, decode=True))
+
+    def timed(n_new, repeats=5):
+        kw = dict(max_new_tokens=n_new, temperature=0.8, top_k=40,
+                  rng=jax.random.key(1))
+        np.asarray(generate(model, params, prompt, **kw))  # compile
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = np.asarray(generate(model, params, prompt, **kw))
+        assert out.shape == (4, 512 + n_new)
+        return (time.perf_counter() - t0) / repeats
+
+    t_long, t_short = timed(128), timed(16)
+    per_tick = (t_long - t_short) / (128 - 16)
+    return {"metric": "gpt2s_decode_tokens_per_s",
+            "value": round(4 / per_tick, 1), "unit": "tokens/s"}
+
+
 def bench_mlp() -> dict:
     import optax
 
@@ -281,8 +318,8 @@ def bench_sweep() -> dict:
 
 
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
-           "resnet50": bench_resnet50, "mlp": bench_mlp,
-           "sweep": bench_sweep}
+           "resnet50": bench_resnet50, "generate": bench_generate,
+           "mlp": bench_mlp, "sweep": bench_sweep}
 
 
 def main() -> None:
