@@ -163,6 +163,18 @@ class Trainer:
             )
 
         rng = jax.random.key(seed)
+        self._prepare_abstract(sample_batch, rng)
+        with jax.set_mesh(self.mesh):
+            self.state = jax.jit(
+                make_state, out_shardings=self.state_shardings
+            )(rng, sample_batch)
+        self._step_fn = self._build_step()
+        return self.state
+
+    def _prepare_abstract(self, sample_batch, rng) -> "TrainState":
+        """Abstract TrainState + self.state_shardings, with NO device work:
+        shared by init() (which then materializes) and restore() (which
+        loads a checkpoint straight into the shardings)."""
         # Boxed abstract init: the Partitioned leaves carry the logical axis
         # names the sharding rules consume. The full abstract state is
         # derived from it (unbox + abstract optimizer init) rather than
@@ -194,12 +206,7 @@ class Trainer:
                 abstract.opt_state, abstract.params, param_sh, self.mesh
             ),
         )
-        with jax.set_mesh(self.mesh):
-            self.state = jax.jit(
-                make_state, out_shardings=self.state_shardings
-            )(rng, sample_batch)
-        self._step_fn = self._build_step()
-        return self.state
+        return abstract
 
     def _model_args(self, batch):
         return self._batch_adapter(batch)
@@ -567,18 +574,47 @@ class Trainer:
             self.checkpoint.wait()
         return metrics
 
+    def restore(self, sample_batch, *, step: int | None = None):
+        """Load a checkpoint into this Trainer WITHOUT a fit loop — the
+        `load_state_dict` analog for evaluation or generation:
+
+            tr = Trainer(model, opt, loss, checkpoint_dir=d)
+            tr.restore(sample_batch)
+            tr.evaluate(val_loader)          # or
+            generate(decode_model, tr.state.params, prompt, ...)
+
+        ``sample_batch`` shapes the abstract state (params are never
+        materialized at init values — the abstract half of init() feeds the
+        checkpoint reader directly); ``step`` picks a checkpoint (default:
+        latest). Restoring re-shards onto THIS Trainer's mesh/strategy even
+        if the saving run used a different one. Returns the TrainState."""
+        from pytorchdistributed_tpu.training.checkpoint import (
+            abstract_state_like,
+        )
+
+        if self.checkpoint is None:
+            raise ValueError("restore() needs a checkpoint_dir")
+        target = step if step is not None else self.checkpoint.latest_step()
+        if target is None:
+            raise ValueError(
+                f"no checkpoint under {self.checkpoint.directory}")
+        abstract = (self._prepare_abstract(sample_batch, jax.random.key(0))
+                    if self.state is None else self.state)
+        self.state = self.checkpoint.restore(
+            abstract_state_like(abstract, self.state_shardings),
+            step=target)
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        if dist.is_main_process():
+            self.logger.info(f"restored step {int(self.state.step)} from "
+                             f"{self.checkpoint.directory}")
+        return self.state
+
     def _resume(self, loader) -> tuple[int, int]:
         """Restore the latest checkpoint (re-sharding onto the current mesh
         if it differs from the saving run's). Returns (epoch to resume at,
         batches of that epoch to skip) — a mid-epoch checkpoint fast-forwards
         past the already-trained prefix so no batch is trained twice."""
-        from pytorchdistributed_tpu.training.checkpoint import (
-            abstract_state_like,
-        )
-
-        if self.state is None:
-            loader.set_epoch(0)
-            self.init(next(iter(loader)))
         step = self.checkpoint.latest_step()
         meta_path = self.checkpoint.directory / f"trainer_meta_{step}.json"
         if meta_path.exists():
@@ -590,8 +626,8 @@ class Trainer:
                     f"{len(loader)} — resuming would skip the wrong batches "
                     f"or retrain duplicates; use the same batch size and "
                     f"replica count as the saving run")
-        self.state = self.checkpoint.restore(
-            abstract_state_like(self.state, self.state_shardings))
+        loader.set_epoch(0)
+        self.restore(next(iter(loader)), step=step)
         step = int(self.state.step)
         steps_per_epoch = max(len(loader), 1)
         start_epoch = step // steps_per_epoch

@@ -154,3 +154,57 @@ def test_mid_epoch_resume_no_duplicate_batches(tmp_path):
         == 2 * steps_per_epoch
     np.testing.assert_allclose(m_resumed["loss"], m_straight["loss"],
                                rtol=1e-5)
+
+
+def test_public_restore_for_inference(tmp_path):
+    """Trainer.restore: load a checkpoint with no fit loop, then generate
+    with the restored params — the load_state_dict-for-eval path."""
+    import dataclasses
+
+    import optax
+
+    from pytorchdistributed_tpu.inference import generate
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    rng = np.random.default_rng(0)
+    cfg = gpt2_config("test", max_seq_len=32)
+    batch = {
+        "tokens": rng.integers(0, 128, (8, 32)).astype(np.int32),
+        "targets": rng.integers(0, 128, (8, 32)).astype(np.int32),
+    }
+    save = Trainer(GPT2(cfg), optax.sgd(1e-2), token_cross_entropy_loss,
+                   mesh=create_mesh(), checkpoint_dir=str(tmp_path))
+    save.train_step(batch)
+    save._save_checkpoint(force=True)
+    save.checkpoint.wait()
+
+    # fresh Trainer on a DIFFERENT sharding strategy restores and serves
+    load = Trainer(GPT2(cfg), optax.sgd(1e-2), token_cross_entropy_loss,
+                   mesh=create_mesh(data=2, fsdp=4), strategy="fsdp",
+                   checkpoint_dir=str(tmp_path))
+    state = load.restore(batch)
+    assert int(state.step) == 1
+    a = np.asarray(jax.device_get(
+        jax.tree.leaves(save.state.params)[0])).ravel()
+    b = np.asarray(jax.device_get(
+        jax.tree.leaves(load.state.params)[0])).ravel()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    with jax.set_mesh(load.mesh):
+        out = generate(dm, load.state.params, batch["tokens"][:2, :4],
+                       max_new_tokens=4, temperature=0.0)
+    assert out.shape == (2, 8)
+
+    # errors are loud: empty dir and missing checkpoint_dir
+    with pytest.raises(ValueError, match="no checkpoint"):
+        Trainer(GPT2(cfg), optax.sgd(1e-2), token_cross_entropy_loss,
+                mesh=create_mesh(),
+                checkpoint_dir=str(tmp_path / "empty")).restore(batch)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Trainer(GPT2(cfg), optax.sgd(1e-2), token_cross_entropy_loss,
+                mesh=create_mesh()).restore(batch)
