@@ -223,6 +223,14 @@ class Trainer:
         cfg = self._transformer_cfg()
         if (getattr(cfg, "pipeline_stages", 1) > 1
                 and getattr(cfg, "pp_schedule", "gpipe") == "1f1b"):
+            if self.accum_steps > 1:
+                # 1F1B already splits the batch into pipeline_microbatches
+                # inside its fused schedule — raise rather than silently
+                # ignore the flag (scale pipeline_microbatches instead).
+                raise ValueError(
+                    "accum_steps > 1 does not compose with "
+                    "pp_schedule='1f1b'; raise pipeline_microbatches "
+                    "instead (the fused schedule is already micro-batched)")
             return self._build_1f1b_step()
         policy = self.precision
         loss_fn = self._loss_fn
@@ -384,6 +392,8 @@ class Trainer:
         """One optimizer step (the reference's ``_run_batch``)."""
         if self.state is None:
             self.init(batch)
+        if self._step_fn is None:  # state came from restore(), not init()
+            self._step_fn = self._build_step()
         if any(not isinstance(v, jax.Array) for v in batch.values()):
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
@@ -574,7 +584,7 @@ class Trainer:
             self.checkpoint.wait()
         return metrics
 
-    def restore(self, sample_batch, *, step: int | None = None):
+    def restore(self, sample_batch=None, *, step: int | None = None):
         """Load a checkpoint into this Trainer WITHOUT a fit loop — the
         `load_state_dict` analog for evaluation or generation:
 
@@ -598,13 +608,25 @@ class Trainer:
         if target is None:
             raise ValueError(
                 f"no checkpoint under {self.checkpoint.directory}")
-        abstract = (self._prepare_abstract(sample_batch, jax.random.key(0))
-                    if self.state is None else self.state)
+        if self.state is None:
+            if sample_batch is None:
+                raise ValueError(
+                    "restore() on an uninitialized Trainer needs a "
+                    "sample_batch to shape the abstract state")
+            abstract = self._prepare_abstract(sample_batch,
+                                              jax.random.key(0))
+        else:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state)
+            self.state = None  # free the live buffers BEFORE orbax
+            # allocates the restored state — otherwise a model sized near
+            # HBM capacity holds 2x params+opt_state during the load
         self.state = self.checkpoint.restore(
             abstract_state_like(abstract, self.state_shardings),
             step=target)
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
+        # The train step builds lazily on the first train_step() — eager
+        # building here would let train-only guards (accum x 1f1b, dropout
+        # in pipelines) break inference-only restores.
         if dist.is_main_process():
             self.logger.info(f"restored step {int(self.state.step)} from "
                              f"{self.checkpoint.directory}")
@@ -626,8 +648,11 @@ class Trainer:
                     f"{len(loader)} — resuming would skip the wrong batches "
                     f"or retrain duplicates; use the same batch size and "
                     f"replica count as the saving run")
-        loader.set_epoch(0)
-        self.restore(next(iter(loader)), step=step)
+        if self.state is None:  # restore() only reads the batch in this case
+            loader.set_epoch(0)
+            self.restore(next(iter(loader)), step=step)
+        else:
+            self.restore(step=step)
         step = int(self.state.step)
         steps_per_epoch = max(len(loader), 1)
         start_epoch = step // steps_per_epoch

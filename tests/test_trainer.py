@@ -212,6 +212,21 @@ def test_accum_steps_matches_large_batch():
     np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5, atol=1e-6)
 
 
+def test_accum_rejects_1f1b():
+    """accum_steps must not be silently ignored on the fused-1F1B path."""
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import token_cross_entropy_loss
+
+    model = GPT2(gpt2_config("test", num_layers=4, pipeline_stages=4,
+                             pipeline_microbatches=4, pp_schedule="1f1b"))
+    tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                 mesh=create_mesh(data=2, pipe=4), accum_steps=2)
+    batch = {"tokens": np.zeros((16, 32), np.int32),
+             "targets": np.zeros((16, 32), np.int32)}
+    with pytest.raises(ValueError, match="pipeline_microbatches"):
+        tr.train_step(batch)
+
+
 def test_accum_steps_validations():
     from pytorchdistributed_tpu.training import mse_loss as _mse
 
