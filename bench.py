@@ -4,9 +4,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Default flagship: GPT-2-small causal-LM training throughput (tokens/s) on
 the available chip(s) — bf16 compute on the MXU, Pallas flash attention,
-adamw, the jitted Trainer hot loop. Other modes (--bench): "mlp" (the
-original smoke), "resnet50" (BASELINE config[1] img/s), "sweep" (the
-reference's pipeline split-size sweep shape, 03_model_parallel.ipynb:586-623).
+adamw, the jitted Trainer hot loop. Other modes (--bench): "gpt2medium"
+(BASELINE config[3]'s model), "llama1b" (RoPE/SwiGLU/GQA + fused CE),
+"resnet50" (BASELINE config[1] img/s), "generate" (KV-cache decode),
+"mlp" (the original smoke), "sweep" (the reference's pipeline split-size
+sweep shape, 03_model_parallel.ipynb:586-623).
 
 Methodology matches the reference's harness (`timeit.repeat`-style: timed
 repeats after a compile warmup, mean reported; 03_model_parallel.ipynb:
@@ -20,6 +22,7 @@ model-FLOPs formula so the utilization claim is checkable.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
@@ -32,6 +35,7 @@ COMMITTED_BASELINES = {
     "gpt2s_train_tokens_per_s": 43381.7,   # BENCH_r01.json
     "llama1b_train_tokens_per_s": 14457.3,  # round-2 first measurement
     "gpt2s_decode_tokens_per_s": 2738.8,    # round-2 (marginal-rate method)
+    "gpt2m_train_tokens_per_s": 41141.8,    # round-2 first measurement
     "resnet50_train_img_per_s": 2058.6,    # round-1 bench_baseline.json
     "pp_sweep_best_tokens_per_s": 4138.0,  # round-1 bench_baseline.json
 }
@@ -102,7 +106,7 @@ def _time_steps(trainer, batch, *, warmup: int = 2, steps: int = 20) -> float:
     return (time.perf_counter() - t0) / steps
 
 
-def bench_gpt2() -> dict:
+def bench_gpt2(size: str = "small") -> dict:
     import optax
 
     from pytorchdistributed_tpu.models import GPT2, gpt2_config
@@ -115,11 +119,12 @@ def bench_gpt2() -> dict:
     import jax
     batch_size, seq_len = 8, 1024
     attention = "pallas" if jax.default_backend() == "tpu" else "dense"
-    # Fastest measured v5e config: layers unrolled (the 12-iteration scan
-    # costs ~8% in while-loop scheduling) and no remat — GPT-2-small at
-    # batch 8 fits v5e HBM without recompute. remat="dots" is the fallback
-    # for bigger models/batches (config.py).
-    cfg = gpt2_config("small", attention=attention, remat=False,
+    # Fastest measured v5e config for both sizes: layers unrolled (the
+    # per-layer scan costs ~8% in while-loop scheduling) and no remat —
+    # small AND medium at batch 8 fit v5e HBM without recompute (medium:
+    # 47.4% MFU, the 1024-wide-matmul shape dividend over small's 45.9%).
+    # remat="dots" is the fallback for bigger models/batches (config.py).
+    cfg = gpt2_config(size, attention=attention, remat=False,
                       scan_layers=False)
     model = GPT2(cfg)
     trainer = Trainer(model, optax.adamw(3e-4), token_cross_entropy_loss,
@@ -133,7 +138,8 @@ def bench_gpt2() -> dict:
     }
     sec = _time_steps(trainer, batch)
     tokens = batch_size * seq_len
-    result = {"metric": "gpt2s_train_tokens_per_s",
+    tag = {"small": "gpt2s", "medium": "gpt2m"}.get(size, f"gpt2_{size}")
+    result = {"metric": f"{tag}_train_tokens_per_s",
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
@@ -318,6 +324,7 @@ def bench_sweep() -> dict:
 
 
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
+           "gpt2medium": functools.partial(bench_gpt2, "medium"),
            "resnet50": bench_resnet50, "generate": bench_generate,
            "mlp": bench_mlp, "sweep": bench_sweep}
 
