@@ -180,3 +180,16 @@ def test_generate_validations():
         generate(dm, params, prompt, max_new_tokens=0)
     with pytest.raises(ValueError, match="pipeline"):
         gpt2_config("test", decode=True, pipeline_stages=2)
+
+
+def test_generate_exactly_fills_max_seq_len():
+    """prompt_len + max_new_tokens == max_seq_len is legal: the last cache
+    write lands on the final slot, one token past raises."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=16, decode=True)
+    model = GPT2(cfg)
+    prompt = jnp.asarray(np.arange(8)[None] % cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    out = generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    assert out.shape == (1, 16)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, params, prompt, max_new_tokens=9)

@@ -273,3 +273,25 @@ def test_fit_with_val_loader_reports_val_metrics():
                  mesh=create_mesh(), log_every=10**9)
     out = tr.fit(loader, max_epochs=2, val_loader=val)
     assert "val_loss" in out and np.isfinite(out["val_loss"])
+
+
+def test_accum_composes_with_fsdp():
+    """Accumulated grads inherit the ZeRO-3 sharding (the fp32 accumulator
+    is zeros_like the sharded params) — loss matches plain fsdp."""
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import token_cross_entropy_loss
+
+    rng = np.random.default_rng(10)
+    batch = {
+        "tokens": rng.integers(0, 128, (32, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (32, 16)).astype(np.int32),
+    }
+    losses = {}
+    for accum in (1, 4):
+        model = GPT2(gpt2_config("test", dtype=np.float32))
+        tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(data=2, fsdp=4), strategy="fsdp",
+                     accum_steps=accum)
+        losses[accum] = [float(tr.train_step(batch)["loss"])
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses[1], losses[4], rtol=2e-4, atol=1e-6)
