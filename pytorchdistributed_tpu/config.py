@@ -41,6 +41,8 @@ class ExperimentConfig:
     # update) — the large-batch recipe when activations exceed HBM.
     accum_steps: int = 1
     mlm_mask_rate: float = 0.15    # BERT dynamic-masking rate
+    dropout_rate: float = 0.0      # transformer-family dropout (training
+    #                                only; losses wire the rng stream)
     pp_schedule: str = "gpipe"     # gpipe | 1f1b (transformer models)
     expert: int = 1                # mesh axis for expert parallelism
     moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
@@ -195,7 +197,8 @@ def _build_model(cfg: ExperimentConfig):
     tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
                pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
                pipeline_microbatches=cfg.pipeline_microbatches,
-               pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts)
+               pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts,
+               dropout_rate=cfg.dropout_rate)
 
     lm_families = {
         "gpt2": (models.GPT2, models.gpt2_config),

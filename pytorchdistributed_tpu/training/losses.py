@@ -5,12 +5,33 @@ DDP hot loop (reference ddp_gpus.py:37-42). Losses here are mean-reduced over
 the *global* batch: under a sharded batch inside `jit`, the mean lowers to a
 local partial sum + `psum` — exactly DDP's gradient-averaging semantics
 without a Reducer.
+
+Dropout contract: the Trainer's per-step rng arrives as ``rng``; when set
+(training) models that declare ``deterministic`` run with
+``deterministic=False`` and a "dropout" rng stream, when None (eval_step)
+they run deterministic — so ``dropout_rate > 0`` configs actually drop
+units during training and are reproducible at eval.
 """
 
 from __future__ import annotations
 
+import inspect
+
 import jax.numpy as jnp
 import optax
+
+
+def _stochastic_kwargs(target, rng):
+    """(kwargs for model.apply) enabling dropout when training: only when
+    the applied method takes ``deterministic`` (the transformer zoo and
+    ViT; ResNet/MLP have no stochastic layers). ``target`` is the callable
+    being applied (a Module's __call__ or a method like
+    loss_per_position)."""
+    if rng is None:
+        return {}
+    if "deterministic" not in inspect.signature(target).parameters:
+        return {}
+    return {"deterministic": False, "rngs": {"dropout": rng}}
 
 
 def mse_loss(model, params, batch, rng=None):
@@ -21,7 +42,8 @@ def mse_loss(model, params, batch, rng=None):
 
 def cross_entropy_loss(model, params, batch, rng=None):
     """Image classification: batch = {image, label}."""
-    logits = model.apply(params, batch["image"])
+    logits = model.apply(params, batch["image"],
+                         **_stochastic_kwargs(type(model).__call__, rng))
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["label"]
     ).mean()
@@ -31,7 +53,8 @@ def cross_entropy_loss(model, params, batch, rng=None):
 
 def token_cross_entropy_loss(model, params, batch, rng=None):
     """LM: batch = {tokens, targets}; optional {loss_mask} for MLM."""
-    logits = model.apply(params, batch["tokens"])
+    logits = model.apply(params, batch["tokens"],
+                         **_stochastic_kwargs(type(model).__call__, rng))
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"]
     )
@@ -53,7 +76,9 @@ def fused_token_cross_entropy_loss(model, params, batch, rng=None):
     use for DP/FSDP training of LM models that define `loss_per_position`.
     """
     ce = model.apply(params, batch["tokens"], batch["targets"],
-                     method=type(model).loss_per_position)
+                     method=type(model).loss_per_position,
+                     **_stochastic_kwargs(type(model).loss_per_position,
+                                          rng))
     mask = batch.get("loss_mask")
     if mask is not None:
         ce = jnp.where(mask, ce, 0.0)
@@ -74,7 +99,8 @@ def moe_token_cross_entropy_loss(model, params, batch, rng=None):
     without it top-1 routing collapses onto one expert."""
     import jax
 
-    logits, mods = model.apply(params, batch["tokens"], mutable=["losses"])
+    logits, mods = model.apply(params, batch["tokens"], mutable=["losses"],
+                               **_stochastic_kwargs(type(model).__call__, rng))
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"])
     mask = batch.get("loss_mask")

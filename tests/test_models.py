@@ -190,3 +190,42 @@ def test_rank1_batch_leaves_with_seq_mesh():
                  mesh=create_mesh(data=4, seq=2), strategy="dp")
     m = tr.train_step(_image_batch(rng))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_dropout_fires_in_training_and_not_in_eval():
+    """dropout_rate > 0 must actually drop units during training (different
+    rng -> different loss on identical params/batch) and stay off at eval
+    (rng=None -> bit-identical, and equal to the rate=0 model's loss)."""
+    from pytorchdistributed_tpu.training.losses import (
+        token_cross_entropy_loss as tl,
+    )
+
+    rng = np.random.default_rng(3)
+    batch = _token_batch(rng, batch=4, seq=16)
+    model = GPT2(gpt2_config("test", dropout_rate=0.2, dtype=np.float32))
+    params = model.init(jax.random.key(0), batch["tokens"])
+    l1 = float(tl(model, params, batch, jax.random.key(1))[0])
+    l2 = float(tl(model, params, batch, jax.random.key(2))[0])
+    l1b = float(tl(model, params, batch, jax.random.key(1))[0])
+    assert l1 != l2          # dropout is live and rng-driven
+    assert l1 == l1b         # and deterministic per key
+    le = float(tl(model, params, batch, None)[0])
+    base = GPT2(gpt2_config("test", dropout_rate=0.0, dtype=np.float32))
+    lb = float(tl(base, params, batch, None)[0])
+    assert le == lb          # eval path = no dropout at all
+
+
+def test_dropout_trains_end_to_end():
+    rng = np.random.default_rng(4)
+    model = GPT2(gpt2_config("test", dropout_rate=0.1))
+    tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                 mesh=create_mesh(data=2, fsdp=4), strategy="fsdp")
+    batch = _token_batch(rng)
+    l0 = float(tr.train_step(batch)["loss"])
+    for _ in range(4):
+        m = tr.train_step(batch)
+    assert float(m["loss"]) < l0
+    # eval_step is deterministic with dropout off
+    e1 = float(tr.eval_step(batch)["loss"])
+    e2 = float(tr.eval_step(batch)["loss"])
+    assert e1 == e2

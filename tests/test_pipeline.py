@@ -231,3 +231,15 @@ def test_pipeline_validations():
     with pytest.raises(ValueError, match="pipe axis"):
         _run_losses(dict(pipeline_stages=2, pipeline_microbatches=2),
                     dict(data=2, pipe=4), steps=1)
+
+
+def test_pipelined_dropout_raises_loudly():
+    """Dropout inside the GPipe shard_map stack is unsupported; now that
+    training rngs actually reach the model, the guard must fire instead of
+    silently training without dropout."""
+    model = GPT2(gpt2_config("test", num_layers=4, dropout_rate=0.1,
+                             pipeline_stages=2, pipeline_microbatches=2))
+    tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                 mesh=create_mesh(data=4, pipe=2), strategy="dp")
+    with pytest.raises(NotImplementedError, match="dropout"):
+        tr.train_step(_BATCH)
