@@ -4,10 +4,15 @@ the alternative when heads ≥ shards").
 
 Inputs arrive sequence-sharded ([B, S/n, H, D] per device). One
 `lax.all_to_all` re-shards them head-wise ([B, S, H/n, D]) so each device
-runs *dense* attention over the full sequence for its head subset; a second
-all-to-all restores sequence sharding. Two all-to-alls per attention call vs
-ring's n ppermutes — cheaper when the head count divides evenly and the
-sequence fits per-device memory.
+runs full-sequence attention for its head subset; a second all-to-all
+restores sequence sharding. Two all-to-alls per attention call vs ring's n
+ppermutes — cheaper when the head count divides evenly.
+
+The interior is the Pallas flash kernel (ops/pallas_attention.py), NOT
+dense attention: each device sees the *full* sequence for its heads, so a
+dense interior would materialize the [S, S] score matrix and forfeit the
+long-context purpose of sequence parallelism. ``impl="xla"`` keeps the
+dense interior as a debugging reference.
 """
 
 from __future__ import annotations
@@ -23,7 +28,7 @@ from pytorchdistributed_tpu.runtime.mesh import Axis
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
-                   scale: float | None):
+                   scale: float | None, impl: str, interpret: bool):
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(
@@ -34,14 +39,23 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
         tiled=True)
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
-    out = dense_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "pallas":
+        from pytorchdistributed_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        out = flash_attention(q, k, v, causal=causal, scale=scale,
+                              interpret=interpret)
+    else:
+        out = dense_attention(q, k, v, causal=causal, scale=scale)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
                           concat_axis=2, tiled=True)
 
 
 def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
-                      scale: float | None = None):
+                      scale: float | None = None, impl: str = "pallas",
+                      interpret: bool | None = None):
     """Sequence-parallel attention via head redistribution; same calling
     convention as ring_attention_sharded."""
     if mesh is None:
@@ -50,12 +64,18 @@ def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
             raise ValueError(
                 "ulysses attention needs a mesh: call under "
                 "jax.set_mesh(mesh) or pass mesh=")
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown ulysses attention impl {impl!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=Axis.SEQ, causal=causal,
-                          scale=scale),
+                          scale=scale, impl=impl, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # same interpret-mode vma limitation as ring_attention_sharded
+        check_vma=False,
     )
     return fn(q, k, v)
