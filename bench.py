@@ -29,15 +29,18 @@ import time
 import numpy as np
 
 # Absolute committed baselines (BASELINE.md "Recorded absolute numbers"):
-# round-1 single-v5e-chip results this build must beat. Fixed in source on
-# purpose — a file the bench writes itself can never look slow.
+# the PREVIOUS round's best single-v5e-chip results, which this round must
+# beat — vs_baseline is the round-over-round regression tripwire. Fixed in
+# source on purpose: a file the bench writes itself can never look slow.
 COMMITTED_BASELINES = {
-    "gpt2s_train_tokens_per_s": 43381.7,   # BENCH_r01.json
-    "llama1b_train_tokens_per_s": 14457.3,  # round-2 first measurement
-    "gpt2s_decode_tokens_per_s": 2738.8,    # round-2 (marginal-rate method)
-    "gpt2m_train_tokens_per_s": 41141.8,    # round-2 first measurement
-    "resnet50_train_img_per_s": 2058.6,    # round-1 bench_baseline.json
-    "pp_sweep_best_tokens_per_s": 4138.0,  # round-1 bench_baseline.json
+    "gpt2s_train_tokens_per_s": 113439.6,  # r2 late (BASELINE.md)
+    "llama1b_train_tokens_per_s": 16971.4,  # r2 late
+    "gpt2s_decode_tokens_per_s": 2738.8,    # r2 late (marginal-rate method)
+    "gpt2m_train_tokens_per_s": 42205.0,    # r2 late
+    # r2 late; r3 trades ~2% here for EMA batch_stats (servable eval)
+    "resnet50_train_img_per_s": 2307.8,
+    "pp_sweep_best_tokens_per_s": 5139.4,  # re-measured on r3 code (2-dev
+    #                                        CPU sim; VERDICT r2 next #9)
 }
 
 
@@ -218,11 +221,13 @@ def bench_resnet50() -> dict:
 
 
 def bench_generate() -> dict:
-    """GPT-2-small KV-cache decode throughput, batch 4 with a 512-token
-    prompt. MARGINAL decode rate, prefill excluded: times 128-new-token
-    and 16-new-token runs (identical prefill) and divides the extra tokens
-    by the extra time — repeat-5 means each, matching the module's
-    repeat-and-mean methodology."""
+    """GPT-2-small KV-cache decode throughput with a 512-token prompt.
+    MARGINAL decode rate, prefill excluded: times 128-new-token and
+    16-new-token runs (identical prefill) and divides the extra tokens by
+    the extra time — repeat-5 means each, matching the module's
+    repeat-and-mean methodology. Primary metric stays the committed batch-4
+    point; a batch-32 point rides along as the serving-throughput scaling
+    evidence."""
     import dataclasses
 
     import jax
@@ -233,24 +238,32 @@ def bench_generate() -> dict:
 
     cfg = gpt2_config("small", scan_layers=False)
     rng = np.random.default_rng(0)
-    prompt = jnp.asarray(rng.integers(0, 50257, (4, 512)), jnp.int32)
-    params = jax.jit(GPT2(cfg).init)(jax.random.key(0), prompt[:, :64])
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 64), jnp.int32))
     model = GPT2(dataclasses.replace(cfg, decode=True))
 
-    def timed(n_new, repeats=5):
-        kw = dict(max_new_tokens=n_new, temperature=0.8, top_k=40,
-                  rng=jax.random.key(1))
-        np.asarray(generate(model, params, prompt, **kw))  # compile
-        t0 = time.perf_counter()
-        for _ in range(repeats):
-            out = np.asarray(generate(model, params, prompt, **kw))
-        assert out.shape == (4, 512 + n_new)
-        return (time.perf_counter() - t0) / repeats
+    def marginal_rate(batch):
+        prompt = jnp.asarray(rng.integers(0, 50257, (batch, 512)), jnp.int32)
 
-    t_long, t_short = timed(128), timed(16)
-    per_tick = (t_long - t_short) / (128 - 16)
+        def timed(n_new, repeats=5):
+            kw = dict(max_new_tokens=n_new, temperature=0.8, top_k=40,
+                      rng=jax.random.key(1))
+            np.asarray(generate(model, params, prompt, **kw))  # compile
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                out = np.asarray(generate(model, params, prompt, **kw))
+            assert out.shape == (batch, 512 + n_new)
+            return (time.perf_counter() - t0) / repeats
+
+        t_long, t_short = timed(128), timed(16)
+        per_tick = (t_long - t_short) / (128 - 16)
+        return batch / per_tick
+
+    r4 = marginal_rate(4)
+    r32 = marginal_rate(32)
     return {"metric": "gpt2s_decode_tokens_per_s",
-            "value": round(4 / per_tick, 1), "unit": "tokens/s"}
+            "value": round(r4, 1), "unit": "tokens/s",
+            "batch32_tokens_per_s": round(r32, 1)}
 
 
 def bench_mlp() -> dict:
@@ -323,10 +336,99 @@ def bench_sweep() -> dict:
             "value": round(32 * 128 / results[best], 1), "unit": "tokens/s"}
 
 
+_SCALING_PER_PROC_BATCH = 8
+
+
+def _scaling_worker(rank, out_path, steps):
+    """One weak-scaling process: fixed per-process batch, multi-process DDP
+    over jax.distributed (env contract from runtime.launch). Rank 0 writes
+    its measured sec/step. Module-level so multiprocessing spawn can pickle
+    it."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import optax
+
+    from pytorchdistributed_tpu.data.loader import shard_batch
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime import dist
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    dist.init_process_group()
+    import jax.numpy as jnp
+
+    model = GPT2(gpt2_config("test", num_layers=4, dtype=jnp.float32))
+    tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                 mesh=create_mesh(), strategy="dp", log_every=10**9,
+                 watchdog=False)
+    rng = np.random.default_rng(rank)
+    b = _SCALING_PER_PROC_BATCH
+    local = {
+        "tokens": rng.integers(0, 128, (b, 64)).astype(np.int32),
+        "targets": rng.integers(0, 128, (b, 64)).astype(np.int32),
+    }
+    batch = shard_batch(local, tr.batch_sharding)
+    tr.init(batch)
+    metrics = None
+    for _ in range(2):
+        metrics = tr.train_step(batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metrics = tr.train_step(batch)
+    float(metrics["loss"])
+    sec = (time.perf_counter() - t0) / steps
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"world": dist.get_world_size(),
+                       "sec_per_step": sec}, f)
+    dist.destroy_process_group()
+
+
+def bench_scaling() -> dict:
+    """Weak-scaling harness for the BASELINE north star ("DDP scaling eff
+    8→256 chips ≥90%"): the same per-process workload on 1/2/4 REAL OS
+    processes (each its own 1-device CPU sim, jax.distributed rendezvous
+    via runtime.launch), efficiency = T_n / (n·T_1) = t_1/t_n
+    (utils.metrics.scaling_efficiency). On the CPU sim the processes share
+    one host's cores, so the absolute efficiency is pessimistic — the
+    value here proves the measurement path; the pod run is the same code
+    with the process count raised (a flag flip)."""
+    import os
+    import sys
+    import tempfile
+
+    from pytorchdistributed_tpu.runtime.launch import launch
+    from pytorchdistributed_tpu.utils.metrics import scaling_efficiency
+
+    sec = {}
+    for n in (1, 2, 4):
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "result.json")
+            launch(_scaling_worker, n, args=(out, 12), devices_per_proc=1,
+                   timeout=900)
+            with open(out) as f:
+                sec[n] = json.load(f)["sec_per_step"]
+    b = _SCALING_PER_PROC_BATCH
+    eff = {n: round(scaling_efficiency(n * b / sec[n], b / sec[1], n), 4)
+           for n in sec}
+    print(f"weak scaling: sec/step {sec} efficiency {eff}",
+          file=sys.stderr, flush=True)
+    return {"metric": "weak_scaling_eff_4proc", "value": eff[4],
+            "unit": "efficiency",
+            "sec_per_step": {str(k): round(v, 5) for k, v in sec.items()},
+            "efficiency": {str(k): v for k, v in eff.items()}}
+
+
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "gpt2medium": functools.partial(bench_gpt2, "medium"),
            "resnet50": bench_resnet50, "generate": bench_generate,
-           "mlp": bench_mlp, "sweep": bench_sweep}
+           "mlp": bench_mlp, "sweep": bench_sweep,
+           "scaling": bench_scaling}
 
 
 def main() -> None:

@@ -95,12 +95,14 @@ class MLMDataset:
     the reference never reaches it; BASELINE config[2] demands it).
 
     Wraps a dataset yielding ``{"tokens", ...}`` and applies BERT's dynamic
-    masking per *fetch* (RoBERTa-style: a sample gets a fresh mask each
-    epoch, deterministic in (seed, indices)): of ``mask_rate`` selected
-    positions, 80% become ``mask_id`` (default: vocab_size-1, reserved by
-    convention), 10% a random token, 10% unchanged. Emits the BERT batch
-    contract: tokens (corrupted), targets (originals), loss_mask (selected
-    positions).
+    masking (RoBERTa-style), deterministic **per sample**: sample ``i``'s
+    mask depends only on ``(seed, i)``, never on which other indices share
+    the fetch — so ``ds[[0, 1]]`` masks sample 0 exactly like ``ds[0]``
+    and MLM val losses stay comparable across batch sizes and replica
+    counts (ADVICE r2). Of ``mask_rate`` selected positions, 80% become
+    ``mask_id`` (default: vocab_size-1, reserved by convention), 10% a
+    random token, 10% unchanged. Emits the BERT batch contract: tokens
+    (corrupted), targets (originals), loss_mask (selected positions).
     """
 
     def __init__(self, base, vocab_size: int, *, mask_rate: float = 0.15,
@@ -122,8 +124,18 @@ class MLMDataset:
         # aliases for the rng entropy (SeedSequence rejects negatives, and
         # ds[-1] must mask identically to ds[len-1])
         flat = flat % max(len(self), 1)
-        rng = np.random.default_rng([self.seed, *flat.tolist()])
-        r = rng.random(tokens.shape)
+        # one independent stream PER index: r and the replacement draws for
+        # row i come from default_rng([seed, i]) alone, so a sample's mask
+        # is identical no matter how it is batched
+        seq_shape = tokens.shape[-1:] if tokens.ndim else tokens.shape
+        r_rows, rand_rows = [], []
+        for i in flat.tolist():
+            row_rng = np.random.default_rng([self.seed, i])
+            r_rows.append(row_rng.random(seq_shape))
+            rand_rows.append(row_rng.integers(
+                0, self.vocab_size - 1, seq_shape, dtype=np.int32))
+        r = np.stack(r_rows).reshape(tokens.shape)
+        rand = np.stack(rand_rows).reshape(tokens.shape)
         selected = r < self.mask_rate
         # split the selected mass 80/10/10 by where r falls inside it
         to_mask = r < self.mask_rate * 0.8
@@ -131,8 +143,6 @@ class MLMDataset:
         corrupted = np.where(to_mask, self.mask_id, tokens)
         # random replacements never emit mask_id (draw over vocab-1 ids,
         # shift past the hole)
-        rand = rng.integers(0, self.vocab_size - 1, tokens.shape,
-                            dtype=np.int32)
         rand = rand + (rand >= self.mask_id)
         corrupted = np.where(to_rand, rand, corrupted)
         return {"tokens": corrupted.astype(np.int32),

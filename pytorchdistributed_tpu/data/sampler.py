@@ -6,9 +6,11 @@ ddp_gpus.py:75-76) and `sampler.set_epoch(epoch)` for a different shuffle
 every epoch (reference ddp_gpus.py:47). This module provides the same
 contract, TPU-first:
 
-  * shuffling uses `jax.random` threefry keys (stateless, identical on every
-    process given the same seed — a requirement for SPMD, where each host must
-    compute the SAME global permutation and then slice out its shard);
+  * shuffling is host-side numpy, seeded with ``seed·1_000_003 + epoch``
+    (stateless in (seed, epoch) with no cross-seed/epoch collisions,
+    identical on every process — a requirement for SPMD, where each host
+    must compute the SAME global permutation and then slice out its shard;
+    no device work for what is index bookkeeping);
   * shards are contiguous slices of the permuted index list, so a host feeding
     N local devices can take one contiguous run and let `jax.device_put` with
     a sharding split it further;

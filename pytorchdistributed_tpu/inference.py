@@ -29,18 +29,22 @@ from jax import lax
 
 
 def _sample(logits, key, *, temperature: float, top_k: int | None,
-            top_p: float | None = None):
+            top_p: float | None = None, top_p_candidates: int = 256):
     """One sampling step over [b, vocab] fp32 logits."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_p is not None:
-        # Nucleus sampling over the top-C candidates (C = top_k or 256):
-        # a full-vocab descending sort costs ~100x per tick on v5e at
-        # vocab 50k, and in practice the p-mass lives far inside the top
-        # 256. Drop candidates once the cumulative probability BEFORE
-        # them reaches p (the first token always survives).
-        c = min(top_k or 256, logits.shape[-1])
+        # Nucleus sampling over the top-C candidates (C = top_k or
+        # top_p_candidates): a full-vocab descending sort costs ~100x per
+        # tick on v5e at vocab 50k, and in practice the p-mass lives far
+        # inside the top 256. For flat/high-temperature distributions
+        # where the true nucleus may be wider, raise top_p_candidates
+        # (vocab_size recovers exact nucleus sampling). Drop candidates
+        # once the cumulative probability BEFORE them reaches p (the
+        # first token always survives); the retained mass is
+        # renormalized over the candidate set.
+        c = min(top_k or top_p_candidates, logits.shape[-1])
         vals, idxs = lax.top_k(logits, c)  # descending
         probs = jax.nn.softmax(vals, axis=-1)
         cum = jnp.cumsum(probs, axis=-1) - probs
@@ -59,7 +63,7 @@ def _sample(logits, key, *, temperature: float, top_k: int | None,
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "eos_id"))
+                     "top_p", "top_p_candidates", "eos_id"))
 def generate(
     model,
     params,
@@ -69,6 +73,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    top_p_candidates: int = 256,
     eos_id: int | None = None,
     rng=None,
 ):
@@ -84,7 +89,10 @@ def generate(
       top_k: restrict sampling to the k highest-logit tokens.
       top_p: nucleus sampling — keep the smallest candidate set with
         cumulative probability >= p (evaluated over the top-(top_k or
-        256) candidates; see _sample). Composes with top_k.
+        top_p_candidates) candidates; see _sample). Composes with top_k.
+      top_p_candidates: how many top logits nucleus sampling considers
+        (default 256; set vocab_size for exact nucleus at full-sort cost —
+        matters for flat/high-temperature distributions).
       eos_id: rows that emit it keep emitting it (static-shape early stop).
       rng: PRNG key for sampling (defaults to key(0); unused when greedy).
 
@@ -107,6 +115,17 @@ def generate(
     if rng is None:
         rng = jax.random.key(0)
 
+    # Bound per-tick attention to the slots this call can actually reach
+    # (128-lane-rounded): at long max_seq_len with a short generation the
+    # dense-over-whole-cache score work is almost all waste. Static under
+    # this jit — prompt_len and max_new_tokens are already trace constants.
+    import dataclasses
+
+    attend = min(cfg.max_seq_len, -(-total // 128) * 128)
+    if (cfg.decode_attend_len or cfg.max_seq_len) != attend:
+        model = model.clone(
+            cfg=dataclasses.replace(cfg, decode_attend_len=attend))
+
     cache = jax.eval_shape(
         lambda: model.init(jax.random.key(0), prompt[:, :1])["cache"])
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
@@ -120,7 +139,8 @@ def generate(
     cache = mut["cache"]
     rng, sub = jax.random.split(rng)
     first = _sample(logits[:, -1].astype(jnp.float32), sub,
-                    temperature=temperature, top_k=top_k, top_p=top_p)
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    top_p_candidates=top_p_candidates)
     done = (first == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
 
     def tick(carry, _):
@@ -130,7 +150,8 @@ def generate(
             mutable=["cache"])
         key, sub = jax.random.split(key)
         nxt = _sample(logits[:, 0].astype(jnp.float32), sub,
-                      temperature=temperature, top_k=top_k, top_p=top_p)
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      top_p_candidates=top_p_candidates)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

@@ -13,12 +13,12 @@ import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
-    TransformerBlock,
     TransformerConfig,
     TransformerStack,
     _dense_general,
     _layer_norm,
     gather_free_ce,
+    make_stage_apply,
 )
 from pytorchdistributed_tpu.parallel.tp import Logical
 
@@ -63,7 +63,6 @@ class BertMLM(nn.Module):
                              f"pipeline_stages {p}")
         if not cfg.scan_layers:
             raise ValueError("pipeline_parts requires scan_layers=True")
-        block = TransformerBlock(cfg, deterministic=True)
 
         def split(params):
             pp = params["params"]
@@ -79,13 +78,6 @@ class BertMLM(nn.Module):
             x = Embedder(cfg).apply({"params": pre["embed"]}, tokens)
             return _layer_norm(cfg, None).apply(
                 {"params": pre["ln_embed"]}, x).astype(cfg.dtype)
-
-        def stage_apply(stage_leaf, h):
-            def layer(h, lp):
-                return block.apply({"params": lp}, h), None
-
-            h, _ = jax.lax.scan(layer, h, stage_leaf)
-            return h
 
         def targets_of(batch):
             targets = batch["targets"]
@@ -120,8 +112,11 @@ class BertMLM(nn.Module):
                 "mlm_ln": head_g["mlm_ln"],
             }}
 
-        return PipelineParts(split, pre_apply, stage_apply, head_loss,
-                             merge_grads, targets_of)
+        return PipelineParts(
+            split, pre_apply, make_stage_apply(cfg), head_loss, merge_grads,
+            targets_of,
+            stage_apply_aux=(make_stage_apply(cfg, aux=True)
+                             if cfg.moe_experts > 0 else None))
 
 
 def bert_config(size: str = "base", **overrides) -> TransformerConfig:

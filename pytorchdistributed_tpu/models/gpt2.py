@@ -16,10 +16,10 @@ import jax.numpy as jnp
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
     LMHead,
-    TransformerBlock,
     TransformerConfig,
     TransformerStack,
     _layer_norm,
+    make_stage_apply,
 )
 
 
@@ -83,7 +83,6 @@ class GPT2(nn.Module):
                              f"pipeline_stages {p}")
         if not cfg.scan_layers:
             raise ValueError("pipeline_parts requires scan_layers=True")
-        block = TransformerBlock(cfg, deterministic=True)
 
         def split(params):
             pp = params["params"]
@@ -98,13 +97,6 @@ class GPT2(nn.Module):
 
         def pre_apply(pre, tokens):
             return Embedder(cfg).apply({"params": pre}, tokens)
-
-        def stage_apply(stage_leaf, h):
-            def layer(h, lp):
-                return block.apply({"params": lp}, h), None
-
-            h, _ = jax.lax.scan(layer, h, stage_leaf)
-            return h
 
         def head_loss(head, h, targets):
             from pytorchdistributed_tpu.models.transformer import (
@@ -131,8 +123,10 @@ class GPT2(nn.Module):
                 tree["lm_head"] = {"kernel": head_g["proj"]}
             return {"params": tree}
 
-        return PipelineParts(split, pre_apply, stage_apply, head_loss,
-                             merge_grads)
+        return PipelineParts(
+            split, pre_apply, make_stage_apply(cfg), head_loss, merge_grads,
+            stage_apply_aux=(make_stage_apply(cfg, aux=True)
+                             if cfg.moe_experts > 0 else None))
 
 
 def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:

@@ -17,11 +17,11 @@ import jax.numpy as jnp
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
     LMHead,
-    TransformerBlock,
     TransformerConfig,
     TransformerStack,
     _layer_norm,
     gather_free_ce,
+    make_stage_apply,
 )
 
 
@@ -69,7 +69,6 @@ class Llama(nn.Module):
                              f"pipeline_stages {p}")
         if not cfg.scan_layers:
             raise ValueError("pipeline_parts requires scan_layers=True")
-        block = TransformerBlock(cfg, deterministic=True)
 
         def split(params):
             pp = params["params"]
@@ -81,13 +80,6 @@ class Llama(nn.Module):
 
         def pre_apply(pre, tokens):
             return Embedder(cfg).apply({"params": pre}, tokens)
-
-        def stage_apply(stage_leaf, h):
-            def layer(h, lp):
-                return block.apply({"params": lp}, h), None
-
-            h, _ = jax.lax.scan(layer, h, stage_leaf)
-            return h
 
         def head_loss(head, h, targets):
             x = _layer_norm(cfg, None).apply({"params": head["ln_f"]}, h)
@@ -103,8 +95,10 @@ class Llama(nn.Module):
                 "lm_head": {"kernel": head_g["proj"]},
             }}
 
-        return PipelineParts(split, pre_apply, stage_apply, head_loss,
-                             merge_grads)
+        return PipelineParts(
+            split, pre_apply, make_stage_apply(cfg), head_loss, merge_grads,
+            stage_apply_aux=(make_stage_apply(cfg, aux=True)
+                             if cfg.moe_experts > 0 else None))
 
 
 def llama_config(size: str = "7b", **overrides) -> TransformerConfig:

@@ -3,12 +3,15 @@
 lesson splits across GPUs, reference 03_model_parallel.ipynb:325-349).
 
 TPU-first choices: NHWC layout (XLA:TPU's native conv layout), bf16 compute
-with fp32 normalization statistics, and **stateless sync batch norm**: the
-norm uses the current batch's statistics, and because the batch is sharded
-inside jit the `jnp.mean` over the batch axis lowers to a cross-chip psum —
-torch's SyncBatchNorm wrapper with zero framework code. (No mutable
-running-average collection: keeps the train step a pure function; an
-inference-time EMA can be layered on top via optax.ema.)
+with fp32 normalization statistics, and **sync batch norm**: in training
+the norm uses the current global batch's statistics — because the batch is
+sharded inside jit, the `jnp.mean` over the batch axis lowers to a
+cross-chip psum, torch's SyncBatchNorm wrapper with zero framework code.
+An EMA of those statistics rides the flax "batch_stats" collection
+(updated in the train step, carried in TrainState, checkpointed) and is
+what `deterministic=True` (eval / serving) normalizes with — so eval
+output is independent of the eval batch composition and batch-1 inference
+is meaningful.
 
 Stages are named so the pipeline partitioner (parallel/pipeline.py) can cut
 the network at stage boundaries, mirroring the reference's two-stage manual
@@ -39,20 +42,36 @@ def _conv(features, kernel, strides, cfg, name):
 
 
 class SyncBatchNorm(nn.Module):
-    """Normalize by the *global* batch statistics (fp32). With the batch
-    sharded over data axes, XLA turns the means into psums — the TPU-native
-    SyncBatchNorm."""
+    """Training (``use_running_average=False``): normalize by the *global*
+    batch statistics (fp32) — with the batch sharded over data axes, XLA
+    turns the means into psums, the TPU-native SyncBatchNorm — and fold
+    them into an EMA in the "batch_stats" collection (when it is mutable,
+    i.e. inside the train step). Eval: normalize by the EMA."""
 
     epsilon: float = 1e-5
+    momentum: float = 0.9
     zero_init_scale: bool = False
+    use_running_average: bool = True
 
     @nn.compact
     def __call__(self, x):
         c = x.shape[-1]
         xf = x.astype(jnp.float32)
-        axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        ema_mean = self.variable("batch_stats", "mean",
+                                 lambda: jnp.zeros((c,), jnp.float32))
+        ema_var = self.variable("batch_stats", "var",
+                                lambda: jnp.ones((c,), jnp.float32))
+        if self.use_running_average:
+            mean, var = ema_mean.value, ema_var.value
+        else:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+            if (not self.is_initializing()
+                    and self.is_mutable_collection("batch_stats")):
+                m = self.momentum
+                ema_mean.value = m * ema_mean.value + (1 - m) * mean
+                ema_var.value = m * ema_var.value + (1 - m) * var
         scale = self.param(
             "scale",
             nn.with_logical_partitioning(
@@ -69,8 +88,9 @@ class SyncBatchNorm(nn.Module):
         return (y * scale + bias).astype(x.dtype)
 
 
-def _bn(cfg, name, *, zero_init_scale: bool = False):
-    return SyncBatchNorm(zero_init_scale=zero_init_scale, name=name)
+def _bn(cfg, name, *, deterministic: bool, zero_init_scale: bool = False):
+    return SyncBatchNorm(zero_init_scale=zero_init_scale,
+                         use_running_average=deterministic, name=name)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,19 +108,20 @@ class BasicBlock(nn.Module):
     cfg: ResNetConfig
     features: int
     strides: int = 1
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x):
-        cfg = self.cfg
+        cfg, det = self.cfg, self.deterministic
         r = _conv(self.features, (3, 3), (self.strides,) * 2, cfg, "conv1")(x)
-        r = nn.relu(_bn(cfg, "bn1")(r))
+        r = nn.relu(_bn(cfg, "bn1", deterministic=det)(r))
         r = _conv(self.features, (3, 3), (1, 1), cfg, "conv2")(r)
         # zero-init the last BN scale: each residual branch starts as identity
-        r = _bn(cfg, "bn2", zero_init_scale=True)(r)
+        r = _bn(cfg, "bn2", deterministic=det, zero_init_scale=True)(r)
         if x.shape != r.shape:
             x = _conv(self.features, (1, 1), (self.strides,) * 2, cfg,
                       "down_conv")(x)
-            x = _bn(cfg, "down_bn")(x)
+            x = _bn(cfg, "down_bn", deterministic=det)(x)
         return nn.relu(x + r)
 
 
@@ -108,20 +129,21 @@ class BottleneckBlock(nn.Module):
     cfg: ResNetConfig
     features: int
     strides: int = 1
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x):
-        cfg = self.cfg
+        cfg, det = self.cfg, self.deterministic
         r = _conv(self.features, (1, 1), (1, 1), cfg, "conv1")(x)
-        r = nn.relu(_bn(cfg, "bn1")(r))
+        r = nn.relu(_bn(cfg, "bn1", deterministic=det)(r))
         r = _conv(self.features, (3, 3), (self.strides,) * 2, cfg, "conv2")(r)
-        r = nn.relu(_bn(cfg, "bn2")(r))
+        r = nn.relu(_bn(cfg, "bn2", deterministic=det)(r))
         r = _conv(self.features * 4, (1, 1), (1, 1), cfg, "conv3")(r)
-        r = _bn(cfg, "bn3", zero_init_scale=True)(r)
+        r = _bn(cfg, "bn3", deterministic=det, zero_init_scale=True)(r)
         if x.shape != r.shape:
             x = _conv(self.features * 4, (1, 1), (self.strides,) * 2, cfg,
                       "down_conv")(x)
-            x = _bn(cfg, "down_bn")(x)
+            x = _bn(cfg, "down_bn", deterministic=det)(x)
         return nn.relu(x + r)
 
 
@@ -129,15 +151,16 @@ class ResNet(nn.Module):
     cfg: ResNetConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, deterministic: bool = True):
         cfg = self.cfg
+        det = deterministic
         x = x.astype(cfg.dtype)
         if cfg.cifar_stem:
             x = _conv(cfg.width, (3, 3), (1, 1), cfg, "stem_conv")(x)
-            x = nn.relu(_bn(cfg, "stem_bn")(x))
+            x = nn.relu(_bn(cfg, "stem_bn", deterministic=det)(x))
         else:
             x = _conv(cfg.width, (7, 7), (2, 2), cfg, "stem_conv")(x)
-            x = nn.relu(_bn(cfg, "stem_bn")(x))
+            x = nn.relu(_bn(cfg, "stem_bn", deterministic=det)(x))
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
         block = BottleneckBlock if cfg.bottleneck else BasicBlock
@@ -147,6 +170,7 @@ class ResNet(nn.Module):
                     cfg,
                     features=cfg.width * 2**stage,
                     strides=2 if b == 0 and stage > 0 else 1,
+                    deterministic=det,
                     name=f"stage{stage + 1}_block{b}",
                 )(x)
 

@@ -66,6 +66,14 @@ class TransformerConfig:
     # collection and attends over it with a position mask; the embedder
     # tracks its own position counter. Same params as decode=False.
     decode: bool = False
+    # Decode-time attention window: score only cache[:, :decode_attend_len]
+    # instead of all max_seq_len slots. inference.generate sets it to the
+    # (128-rounded) prompt+new total, so per-tick attention cost tracks the
+    # sequence actually being generated, not the model's context limit —
+    # at 8k context with a 1k generation that is an 8x score-work cut.
+    # None = full max_seq_len. Caller contract: positions >= the window are
+    # never live (generate guarantees total <= decode_attend_len).
+    decode_attend_len: int | None = None
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -106,6 +114,22 @@ class TransformerConfig:
         if self.decode and self.pipeline_stages > 1:
             raise ValueError("decode mode does not compose with pipeline "
                              "parallelism (generate on a dp/tp mesh instead)")
+        if self.decode_attend_len is not None and (
+                self.decode_attend_len < 1
+                or self.decode_attend_len > self.max_seq_len):
+            raise ValueError(
+                f"decode_attend_len {self.decode_attend_len} must be in "
+                f"[1, max_seq_len={self.max_seq_len}]")
+        if self.decode and self.attention != "dense":
+            # The decode path runs its own masked attention over the KV
+            # cache; the training-time backend knob does not apply there.
+            import warnings
+
+            warnings.warn(
+                f"decode=True always uses the cache-masked dense path; "
+                f"attention={self.attention!r} is ignored during decode "
+                f"(build the decode model with attention='dense' to "
+                f"silence this)", stacklevel=3)
 
     @property
     def kv_heads(self) -> int:
@@ -291,15 +315,21 @@ class SelfAttention(nn.Module):
                 cached_v.value = jax.lax.dynamic_update_slice(
                     cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
                 idx_var.value = idx + s
-            kc, vc = cached_k.value, cached_v.value
+            # Static attention window (decode_attend_len): the cache stays
+            # max_seq_len-sized, but scores only cover the slots generation
+            # can actually reach — generate() sets the bound from
+            # prompt_len + max_new_tokens.
+            attend = cfg.decode_attend_len or cfg.max_seq_len
+            kc = cached_k.value[:, :attend]
+            vc = cached_v.value[:, :attend]
             if rep > 1:
                 kc = jnp.repeat(kc, rep, axis=2)
                 vc = jnp.repeat(vc, rep, axis=2)
-            # Masked dense attention over the whole cache: the current
+            # Masked dense attention over the live window: the current
             # chunk's token i (absolute position idx+i) sees cache slots
             # j <= idx+i. fp32 softmax like the training backends.
             pos = idx + jnp.arange(s)
-            valid = jnp.arange(cfg.max_seq_len)[None, :] <= pos[:, None]
+            valid = jnp.arange(attend)[None, :] <= pos[:, None]
             scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
@@ -309,11 +339,13 @@ class SelfAttention(nn.Module):
                              preferred_element_type=jnp.float32
                              ).astype(cfg.dtype)
         else:
-            if rep > 1:
-                # Broadcast KV groups to full head count before the
-                # backend — the param/HBM saving is already banked in the
-                # projection; the repeat stays in registers/VMEM under XLA
-                # fusion.
+            if rep > 1 and cfg.attention != "pallas":
+                # Broadcast KV groups to full head count for backends that
+                # expect equal head counts (dense / ring / ulysses). The
+                # Pallas kernel is grouped-query-native: its index maps
+                # stream the shared K/V per group, so the 4x repeat (two
+                # activation-sized HBM tensors per layer plus the summed
+                # dk/dv transpose in backward) never materializes.
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
@@ -441,6 +473,59 @@ class TransformerBlock(nn.Module):
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 
 
+def make_stage_apply(cfg: TransformerConfig, *, aux: bool = False):
+    """Build the pipeline stage body shared by the GPipe apply path
+    (TransformerStack._pipelined) and the models' 1F1B ``pipeline_parts``:
+    apply ``num_layers/pipeline_stages`` TransformerBlocks from a
+    stage-stacked param leaf.
+
+    The returned ``stage_apply(stage_leaf, h, key=None)``:
+      * with ``key`` (the schedule's ``stage_microbatch_key``), folds the
+        layer index on top and runs the blocks stochastic — dropout streams
+        are unique per (stage, micro-batch, layer);
+      * with ``aux=True`` returns ``(h, aux_sum)`` where aux_sum collects
+        the Switch-MoE load-balance values the blocks sow — raw
+        ``block.apply`` outside the module system would otherwise drop them
+        silently (a collapsing router with no warning).
+    """
+    per = cfg.num_layers // cfg.pipeline_stages
+    det_block = TransformerBlock(cfg, deterministic=True)
+    sto_block = TransformerBlock(cfg, deterministic=False)
+
+    def stage_apply(stage_leaf, h, key=None):
+        block = det_block if key is None else sto_block
+
+        def rngs_for(j):
+            return (None if key is None
+                    else {"dropout": jax.random.fold_in(key, j)})
+
+        if aux:
+            from pytorchdistributed_tpu.parallel.pipeline import _to_varying
+
+            def layer(carry, xs):
+                h, aux_acc = carry
+                lp, j = xs
+                h, mods = block.apply({"params": lp}, h, rngs=rngs_for(j),
+                                      mutable=["losses"])
+                sown = jax.tree.leaves(mods.get("losses", {}))
+                aux_acc = aux_acc + sum(jnp.mean(v) for v in sown)
+                return (h, aux_acc), None
+
+            (h, aux_sum), _ = jax.lax.scan(
+                layer, (h, _to_varying(jnp.zeros((), jnp.float32))),
+                (stage_leaf, jnp.arange(per)))
+            return h, aux_sum
+
+        def layer(h, xs):
+            lp, j = xs
+            return block.apply({"params": lp}, h, rngs=rngs_for(j)), None
+
+        h, _ = jax.lax.scan(layer, h, (stage_leaf, jnp.arange(per)))
+        return h
+
+    return stage_apply
+
+
 class TransformerStack(nn.Module):
     """num_layers blocks, optionally folded into one `nn.scan` whose carry is
     the activations. The scanned parameter axis gets logical name "stage"
@@ -478,7 +563,10 @@ class TransformerStack(nn.Module):
         """Apply-path GPipe: reuse the layer-stacked params the init-path
         nn.scan created ([L, ...] leaves, logical axis "stage" → mesh axis
         "pipe") and drive them with the shard_map pipeline schedule
-        (parallel/pipeline.py) instead of the sequential scan."""
+        (parallel/pipeline.py) instead of the sequential scan. Dropout rides
+        as a per-(stage, micro-batch, layer) key stream; the Switch-MoE aux
+        loss is collected from the schedule and re-sown so the moe loss fn
+        sees it exactly like the sequential stack's."""
         from pytorchdistributed_tpu.parallel.pipeline import gpipe_spmd
 
         cfg = self.cfg
@@ -489,27 +577,28 @@ class TransformerStack(nn.Module):
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
                 f"pipeline_stages {p}")
-        if cfg.dropout_rate > 0 and not deterministic:
-            raise NotImplementedError(
-                "dropout inside the pipelined stack is not supported yet")
         stacked = self.get_variable("params", "block")
         # [L, ...] -> [P, L/P, ...]: contiguous layer groups become stages,
         # matching the existing stage-axis sharding layout.
         stage_params = jax.tree.map(
             lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
             stacked)
-        block_mod = TransformerBlock(cfg, deterministic)
-
-        def stage_apply(params, h):
-            def layer(h, layer_params):
-                return block_mod.apply({"params": layer_params}, h), None
-
-            h, _ = jax.lax.scan(layer, h, params)
-            return h
-
-        return gpipe_spmd(stage_apply, stage_params, x,
-                          num_microbatches=cfg.pipeline_microbatches,
-                          remat=cfg.remat, remat_policy=cfg.remat_policy)
+        train_dropout = cfg.dropout_rate > 0 and not deterministic
+        dropout_rng = self.make_rng("dropout") if train_dropout else None
+        collect_aux = cfg.moe_experts > 0
+        out = gpipe_spmd(make_stage_apply(cfg, aux=collect_aux),
+                         stage_params, x,
+                         num_microbatches=cfg.pipeline_microbatches,
+                         remat=cfg.remat, remat_policy=cfg.remat_policy,
+                         dropout_rng=dropout_rng, collect_aux=collect_aux)
+        if collect_aux:
+            out, aux = out
+            # same convention as the sequential scan's [L]-sow consumed by
+            # losses.moe_token_cross_entropy_loss: a mean over layers
+            # (gpipe_spmd already averaged over micro-batches); sow is a
+            # silent no-op when "losses" isn't mutable (plain CE loss)
+            self.sow("losses", "moe_aux", aux / cfg.num_layers)
+        return out
 
 
 class LMHead(nn.Module):

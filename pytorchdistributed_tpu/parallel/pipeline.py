@@ -78,6 +78,16 @@ from jax.sharding import PartitionSpec as P
 from pytorchdistributed_tpu.runtime.mesh import Axis
 
 
+def stage_microbatch_key(base, stage, microbatch):
+    """The ONE key-derivation rule for stochastic layers inside pipeline
+    schedules: both GPipe and 1F1B fold (micro-batch, stage) into the
+    per-step base key, and the 1F1B backward slot re-derives the same key
+    for its recompute — dropout masks are identical in forward and
+    recompute by construction. Stage bodies fold the layer index on top
+    (models/transformer.make_stage_apply)."""
+    return jax.random.fold_in(jax.random.fold_in(base, microbatch), stage)
+
+
 def gpipe_spmd(
     stage_apply: Callable,
     stage_params,
@@ -87,6 +97,8 @@ def gpipe_spmd(
     mesh=None,
     remat: bool = True,
     remat_policy: str = "full",
+    dropout_rng=None,
+    collect_aux: bool = False,
 ):
     """Run ``stage_apply(params_for_my_stage, h) -> h`` as a GPipe pipeline
     over the "pipe" mesh axis.
@@ -95,6 +107,17 @@ def gpipe_spmd(
     sharded over "pipe"). ``x``: [batch, ...] global activations (any
     data/seq sharding — those axes stay automatic). ``num_microbatches``
     must divide the global batch. Returns activations with x's layout.
+
+    ``dropout_rng``: when given, ``stage_apply`` is called as
+    ``stage_apply(params, h, key)`` with ``key =
+    stage_microbatch_key(dropout_rng, stage, microbatch)`` — every
+    (stage, micro-batch) pair draws an independent dropout stream.
+
+    ``collect_aux``: when True, ``stage_apply`` returns ``(h, aux)`` with
+    ``aux`` a scalar per-stage auxiliary loss (the Switch-MoE load-balance
+    term); the return becomes ``(activations, aux_mean)`` where aux_mean
+    averages over micro-batches and sums over stages. Its gradient flows
+    through ordinary AD of the schedule.
     """
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
@@ -121,20 +144,26 @@ def gpipe_spmd(
 
     param_spec = jax.tree.map(lambda _: P(Axis.PIPE), stage_params)
 
+    args = (stage_params, x)
+    in_specs = (param_spec, P())
+    out_specs = (P(), P()) if collect_aux else P()
+    if dropout_rng is not None:
+        args += (dropout_rng,)
+        in_specs += (P(),)
     fn = jax.shard_map(
         functools.partial(_gpipe_local, stage_apply,
                           num_microbatches=num_microbatches,
-                          n_stages=n_stages),
+                          n_stages=n_stages, collect_aux=collect_aux),
         mesh=mesh,
         axis_names={Axis.PIPE},
-        in_specs=(param_spec, P()),
-        out_specs=P(),
+        in_specs=in_specs,
+        out_specs=out_specs,
     )
-    return fn(stage_params, x)
+    return fn(*args)
 
 
-def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
-                 n_stages: int):
+def _gpipe_local(stage_apply, stage_params, x, rng=None, *,
+                 num_microbatches: int, n_stages: int, collect_aux: bool):
     """Per-device pipeline body (inside shard_map, "pipe" axis manual)."""
     m = num_microbatches
     p = n_stages
@@ -165,31 +194,47 @@ def _gpipe_local(stage_apply, stage_params, x, *, num_microbatches: int,
 
     acts0 = varying_zeros(x_mb[0].shape, x.dtype)
     outs0 = varying_zeros(x_mb.shape, x.dtype)
+    aux0 = varying_zeros((), jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def tick(carry, t):
-        acts, outs = carry
+        acts, outs, aux_acc = carry
         # stage 0 feeds micro-batch t; everyone else consumes the rotated
-        # activation from the previous stage
+        # activation from the previous stage; this stage is processing
+        # micro-batch t - my_stage (garbage outside [0, m), masked below)
         feed = x_mb[jnp.clip(t, 0, m - 1)]
         h_in = jnp.where(my_stage == 0, feed, acts)
-        h_out = stage_apply(stage_params, h_in)
+        mb_idx = jnp.clip(t - my_stage, 0, m - 1)
+        if rng is None:
+            h_out = stage_apply(stage_params, h_in)
+        else:
+            h_out = stage_apply(stage_params, h_in,
+                                stage_microbatch_key(rng, my_stage, mb_idx))
+        if collect_aux:
+            h_out, aux = h_out
+            active = (t >= my_stage) & (t - my_stage < m)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
         # last stage banks micro-batch t-(p-1) at tick t
         out_idx = jnp.clip(t - (p - 1), 0, m - 1)
         banked = lax.dynamic_update_index_in_dim(outs, h_out, out_idx, 0)
         write = (my_stage == p - 1) & (t >= p - 1)
         outs = jnp.where(write, banked, outs)
         acts = lax.ppermute(h_out, Axis.PIPE, perm)
-        return (acts, outs), None
+        return (acts, outs, aux_acc), None
 
-    (_, outs), _ = lax.scan(tick, (acts0, outs0), jnp.arange(m + p - 1))
+    (_, outs, aux_acc), _ = lax.scan(tick, (acts0, outs0, aux0),
+                                     jnp.arange(m + p - 1))
     # only stage p-1 holds real outputs; psum over "pipe" replicates them
     # (and marks the result invariant over the axis for the out_spec).
     # fp32 for the wire: XLA promotes sub-fp32 all-reduces anyway, and its
     # CPU backend crashes doing so (AllReducePromotion on bf16).
     masked = jnp.where(my_stage == p - 1, outs, jnp.zeros_like(outs))
     outs = lax.psum(masked.astype(jnp.float32), Axis.PIPE).astype(outs.dtype)
-    return outs.reshape(b, *outs.shape[2:])
+    outs = outs.reshape(b, *outs.shape[2:])
+    if collect_aux:
+        # sum over stages (psum), mean over micro-batches
+        return outs, lax.psum(aux_acc, Axis.PIPE) / m
+    return outs
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +262,14 @@ class PipelineParts:
         micro-batch — lets a model precompute globally-normalized loss
         weights (masked LM) so per-micro-batch losses still sum exactly to
         the full-batch loss. Default: ``batch["targets"]``.
+      * ``stage_apply_aux`` (optional): ``(stage_leaf, h, key=None) ->
+        (h, aux)`` variant returning a scalar per-stage auxiliary loss (the
+        Switch-MoE load-balance term); selected by the Trainer when
+        ``moe_experts > 0`` together with ``one_f_one_b(aux_weight=...)``.
+
+    ``stage_apply`` may take an optional third ``key`` argument (dropout
+    stream); the schedule passes ``stage_microbatch_key(rng, stage, mb)``
+    when the Trainer supplies a ``dropout_rng``.
     """
 
     split: Callable
@@ -225,6 +278,7 @@ class PipelineParts:
     head_loss: Callable
     merge_grads: Callable
     targets_of: Callable | None = None
+    stage_apply_aux: Callable | None = None
 
 
 def _require_pipe_mesh(mesh, who: str):
@@ -251,6 +305,8 @@ def one_f_one_b(
     *,
     num_microbatches: int,
     mesh=None,
+    dropout_rng=None,
+    aux_weight: float = 0.0,
 ):
     """Non-interleaved 1F1B pipeline **train-grads** primitive (the
     reference's PipeDream-flush schedule, 03_model_parallel.ipynb:668-697).
@@ -278,6 +334,14 @@ def one_f_one_b(
       x: ``[batch, ...]`` activations entering stage 0 (e.g. embedded
         tokens). Other mesh axes (data/fsdp/tensor/seq) stay automatic.
       targets: ``[batch, ...]`` labels consumed by ``head_loss``.
+      dropout_rng: optional per-step key; when given, stage_apply is called
+        with ``stage_microbatch_key(dropout_rng, stage, microbatch)`` —
+        and the backward slot re-derives the SAME key for its recompute,
+        so dropout masks match between forward and recomputation.
+      aux_weight: when nonzero, stage_apply must return ``(h, aux)``; the
+        loss gains ``aux_weight · mean_mb(Σ_stages aux)``, whose gradient
+        is seeded locally in each backward slot (the aux term never flows
+        through later stages — it is a direct function of the stage).
 
     Returns:
       ``(loss, stage_grads, head_grads, dx)``: mean loss over micro-batches;
@@ -300,15 +364,21 @@ def one_f_one_b(
     param_spec = jax.tree.map(lambda _: P(Axis.PIPE), stage_params)
     rep = jax.tree.map(lambda _: P(), head_params)
 
+    args = (stage_params, head_params, x, targets)
+    in_specs = (param_spec, rep, P(), P())
+    if dropout_rng is not None:
+        args += (dropout_rng,)
+        in_specs += (P(),)
     fn = jax.shard_map(
         functools.partial(_one_f_one_b_local, stage_apply, head_loss,
-                          m=num_microbatches, p=n_stages),
+                          m=num_microbatches, p=n_stages,
+                          aux_weight=aux_weight),
         mesh=mesh,
         axis_names={Axis.PIPE},
-        in_specs=(param_spec, rep, P(), P()),
+        in_specs=in_specs,
         out_specs=(P(), param_spec, rep, P()),
     )
-    return fn(stage_params, head_params, x, targets)
+    return fn(*args)
 
 
 def _to_varying(v):
@@ -323,7 +393,8 @@ def _to_varying(v):
 
 
 def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
-                       x, targets, *, m: int, p: int):
+                       x, targets, rng=None, *, m: int, p: int,
+                       aux_weight: float = 0.0):
     """Per-device 1F1B body (inside shard_map, "pipe" axis manual)."""
     s = lax.axis_index(Axis.PIPE)
     r = 2 * p - 1  # residual ring-buffer slots: ≥ max in-flight (2P-2) + 1
@@ -352,6 +423,7 @@ def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
         jax.tree.map(lambda a: vz(a.shape, a.dtype), stage_params),
         jax.tree.map(lambda a: vz(a.shape, a.dtype), head_params),
         vz((), jnp.float32),                            # loss accumulator
+        vz((), jnp.float32),                            # aux-loss accumulator
         vz(x_mb.shape, act_dtype),                      # dx per micro-batch
     )
     fwd = [(i, (i + 1) % p) for i in range(p)]
@@ -361,15 +433,26 @@ def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
         return jax.tree.map(
             lambda a, d: a + jnp.where(active, d, jnp.zeros_like(d)), acc, g)
 
+    def apply_stage(params, h, key):
+        """stage_apply with the optional dropout key; normalizes the return
+        to (h, aux) — aux is only consumed when aux_weight is set."""
+        out = (stage_apply(params, h) if key is None
+               else stage_apply(params, h, key))
+        return out if aux_weight else (out, None)
+
     def tick(carry, u):
-        f_recv, b_recv, resid, stage_g, head_g, loss_acc, dx = carry
+        (f_recv, b_recv, resid, stage_g, head_g, loss_acc, aux_acc,
+         dx) = carry
 
         # ---- forward slot: micro-batch k_f = u - s ----
         k_f = u - s
         active_f = (k_f >= 0) & (k_f < m)
         kf = jnp.clip(k_f, 0, m - 1)
         h_in = jnp.where(s == 0, x_mb[kf], f_recv)
-        h_out = stage_apply(stage_params, h_in)
+        key_f = None if rng is None else stage_microbatch_key(rng, s, kf)
+        h_out, aux_f = apply_stage(stage_params, h_in, key_f)
+        if aux_weight:
+            aux_acc = aux_acc + jnp.where(active_f, aux_f, 0.0)
         resid = jnp.where(
             active_f,
             lax.dynamic_update_index_in_dim(resid, h_in, kf % r, 0), resid)
@@ -393,9 +476,19 @@ def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
         g_in = jnp.where(s == p - 1, dh_loss.astype(act_dtype), b_recv)
         h_res = resid[kb % r]
         # Recompute the stage forward from the stored input to rebuild the
-        # VJP — activation recomputation by construction.
-        _, stage_vjp = jax.vjp(stage_apply, stage_params, h_res)
-        dstage, dh_in = stage_vjp(g_in)
+        # VJP — activation recomputation by construction. The SAME
+        # (stage, micro-batch) key re-derives the forward's dropout masks.
+        key_b = None if rng is None else stage_microbatch_key(rng, s, kb)
+        _, stage_vjp = jax.vjp(
+            lambda sp, h: apply_stage(sp, h, key_b), stage_params, h_res)
+        if aux_weight:
+            # The aux term is a direct function of this stage — its
+            # cotangent (aux_weight / M, from loss = aux_weight·mean_mb)
+            # is seeded here and never rides the inter-stage wires.
+            aux_seed = _to_varying(jnp.full((), aux_weight / m, jnp.float32))
+            dstage, dh_in = stage_vjp((g_in, aux_seed))
+        else:
+            dstage, dh_in = stage_vjp((g_in, None))
         stage_g = masked_add(stage_g, dstage, active_b)
         dx = jnp.where(
             active_b & (s == 0),
@@ -404,10 +497,11 @@ def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
         # ---- rotate: activations one hop forward, cotangents one back ----
         f_recv = lax.ppermute(h_out, Axis.PIPE, fwd)
         b_recv = lax.ppermute(dh_in, Axis.PIPE, bwd)
-        return (f_recv, b_recv, resid, stage_g, head_g, loss_acc, dx), None
+        return (f_recv, b_recv, resid, stage_g, head_g, loss_acc, aux_acc,
+                dx), None
 
     carry, _ = lax.scan(tick, carry0, jnp.arange(m + 2 * p - 2))
-    _, _, _, stage_g, head_g, loss_acc, dx = carry
+    _, _, _, stage_g, head_g, loss_acc, aux_acc, dx = carry
 
     def replicate_from(acc, holder):
         """psum the holder stage's accumulator to every device (fp32 wire:
@@ -418,6 +512,8 @@ def _one_f_one_b_local(stage_apply, head_loss, stage_params, head_params,
         return jax.tree.map(one, acc)
 
     loss = lax.psum(jnp.where(s == p - 1, loss_acc, 0.0), Axis.PIPE) / m
+    if aux_weight:
+        loss = loss + aux_weight * lax.psum(aux_acc, Axis.PIPE) / m
     head_g = replicate_from(head_g, s == p - 1)
     dx = replicate_from(dx, s == 0)
     stage_g = jax.tree.map(lambda g: g[None], stage_g)  # [1,...] -> P-stacked

@@ -43,6 +43,16 @@ class Policy:
         return Policy()
 
     def cast_params_for_compute(self, params):
+        """Cast floating leaves to the compute dtype — EXCEPT normalization
+        running statistics ("batch_stats"): the EMA update must read its
+        fp32 master each step, or per-step bf16 quantization noise
+        accumulates in the eval stats (the same rule torch amp applies to
+        BN running stats)."""
+        if isinstance(params, dict) and "batch_stats" in params:
+            out = _cast_floating(
+                {k: v for k, v in params.items() if k != "batch_stats"},
+                self.compute_dtype)
+            return {**out, "batch_stats": params["batch_stats"]}
         return _cast_floating(params, self.compute_dtype)
 
     def cast_batch(self, batch):

@@ -9,17 +9,31 @@ for failures, and on ``--max-restarts > 0`` tears the group down and
 relaunches it — restart-from-checkpoint semantics (workers are expected to
 resume via Trainer.fit(resume=True); SURVEY.md §5 "Failure detection /
 elastic recovery").
+
+``--heartbeat-timeout T`` adds *hung*-rank detection on top of exit
+watching: a rank wedged in a collective (the NCCL-deadlock analog) never
+exits, so the agent also tracks per-rank liveness files
+(runtime/heartbeat.py; the Trainer beats at its device-sync points) and
+treats a rank silent for more than T seconds as failed — kill the group,
+relaunch if restarts remain.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import time
+
+from pytorchdistributed_tpu.runtime.heartbeat import (
+    HEARTBEAT_DIR_ENV,
+    stale_ranks,
+)
 
 
 def _free_port() -> int:
@@ -29,7 +43,8 @@ def _free_port() -> int:
 
 
 def _spawn_group(argv, nproc: int, port: int,
-                 devices_per_proc: int | None) -> list[subprocess.Popen]:
+                 devices_per_proc: int | None,
+                 heartbeat_dir: str | None = None) -> list[subprocess.Popen]:
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
@@ -40,6 +55,8 @@ def _spawn_group(argv, nproc: int, port: int,
             "MASTER_ADDR": "localhost",
             "MASTER_PORT": str(port),
         })
+        if heartbeat_dir is not None:
+            env[HEARTBEAT_DIR_ENV] = heartbeat_dir
         if devices_per_proc is not None:
             from pytorchdistributed_tpu.runtime.launch import sim_device_flags
             env["JAX_PLATFORMS"] = "cpu"
@@ -53,6 +70,9 @@ def _kill_group(procs) -> None:
     for p in procs:
         if p.poll() is None:
             p.send_signal(signal.SIGTERM)
+            # a SIGSTOPped (hung-and-frozen) worker can't handle SIGTERM;
+            # wake it so termination isn't stuck behind the 10s escalation
+            p.send_signal(signal.SIGCONT)
     deadline = time.time() + 10
     for p in procs:
         try:
@@ -71,6 +91,13 @@ def main(argv=None) -> int:
                         help="relaunch the whole group this many times if a "
                              "rank fails (workers resume from checkpoints)")
     parser.add_argument("--monitor-interval", type=float, default=0.2)
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                        help="seconds of per-rank heartbeat silence before "
+                             "the group counts as hung and is relaunched "
+                             "(0 = exit-watching only)")
+    parser.add_argument("--heartbeat-grace", type=float, default=300.0,
+                        help="extra allowance before a rank's FIRST beat "
+                             "(imports + first XLA compile)")
     parser.add_argument("--devices-per-proc", type=int, default=None,
                         help="CPU-sim chips per process (sets JAX_PLATFORMS="
                              "cpu + xla_force_host_platform_device_count)")
@@ -82,9 +109,14 @@ def main(argv=None) -> int:
     restarts = 0
     while True:
         port = _free_port()
+        # fresh heartbeat dir per incarnation: a relaunch must not inherit
+        # the dead group's file mtimes
+        hb_dir = (tempfile.mkdtemp(prefix="ptd_heartbeat_")
+                  if args.heartbeat_timeout > 0 else None)
+        spawned_at = time.time()
         procs = _spawn_group(worker_argv, args.nproc_per_node, port,
-                             args.devices_per_proc)
-        failed_rank = None
+                             args.devices_per_proc, hb_dir)
+        failed_rank, why = None, "failed"
         while failed_rank is None:
             time.sleep(args.monitor_interval)
             codes = [p.poll() for p in procs]
@@ -92,14 +124,28 @@ def main(argv=None) -> int:
                 failed_rank = codes.index(
                     next(c for c in codes if c not in (None, 0)))
             elif all(c == 0 for c in codes):
+                if hb_dir is not None:
+                    shutil.rmtree(hb_dir, ignore_errors=True)
                 return 0
+            elif hb_dir is not None:
+                hung = stale_ranks(hb_dir, args.nproc_per_node,
+                                   timeout=args.heartbeat_timeout,
+                                   grace=args.heartbeat_grace,
+                                   now=time.time(), baseline=spawned_at)
+                # only live ranks count as hung — a cleanly-exited rank
+                # stops beating legitimately while the rest finish up
+                hung = [r for r in hung if codes[r] is None]
+                if hung:
+                    failed_rank, why = hung[0], "hung (heartbeat stale)"
         _kill_group(procs)
+        if hb_dir is not None:  # each incarnation gets a fresh dir
+            shutil.rmtree(hb_dir, ignore_errors=True)
         if restarts >= args.max_restarts:
-            print(f"[run] rank {failed_rank} failed; no restarts left",
+            print(f"[run] rank {failed_rank} {why}; no restarts left",
                   file=sys.stderr)
             return 1
         restarts += 1
-        print(f"[run] rank {failed_rank} failed; restart "
+        print(f"[run] rank {failed_rank} {why}; restart "
               f"{restarts}/{args.max_restarts}", file=sys.stderr)
 
 

@@ -1,0 +1,77 @@
+"""Per-rank liveness heartbeats for hung-rank detection (SURVEY.md §5
+"Failure detection": the reference *uses* torchrun's elastic agent,
+ddp_gpus_torchrun.py:102, but a rank wedged in a collective — the NCCL
+deadlock analog — never *exits*, so exit-watching alone hangs the group
+forever).
+
+Contract: the launcher (`pytorchdistributed_tpu.run --heartbeat-timeout T`)
+exports ``PTD_HEARTBEAT_DIR``; each worker touches ``rank<RANK>`` in it
+whenever it proves forward progress, and the agent kills + relaunches the
+group when any rank's file goes stale for more than T seconds.
+
+What counts as progress: a beat must follow a *device sync* (reading a
+metric value back), not merely host-loop progress — JAX dispatch is async,
+so a host can happily loop enqueueing steps while the devices sit
+deadlocked in a collective. The Trainer beats exactly where it blocks on
+device values (the log-cadence metrics read), so choose
+``T >> log_every × step_time``.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+HEARTBEAT_DIR_ENV = "PTD_HEARTBEAT_DIR"
+
+
+class Heartbeat:
+    """Touches this rank's liveness file; cheap enough to call in the hot
+    loop (an utime syscall, no device work)."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        # Deliberately NO beat here: the first beat must mark real
+        # progress. Stamping the file at construction would start the
+        # agent's `timeout` clock before the first XLA compile (minutes on
+        # big models) — the launcher's more generous `grace` window covers
+        # a rank until it has genuinely beaten once.
+
+    @classmethod
+    def from_env(cls) -> "Heartbeat | None":
+        """The worker-side hook: a Heartbeat when the launcher asked for
+        one (PTD_HEARTBEAT_DIR set), else None."""
+        d = os.environ.get(HEARTBEAT_DIR_ENV)
+        if not d:
+            return None
+        return cls(Path(d) / f"rank{os.environ.get('RANK', '0')}")
+
+    def beat(self) -> None:
+        try:
+            os.utime(self.path)
+        except FileNotFoundError:
+            self.path.touch()
+
+
+def stale_ranks(directory: str | os.PathLike, nproc: int, *, timeout: float,
+                grace: float, now: float, baseline: float) -> list[int]:
+    """Agent-side check: ranks in [0, nproc) whose last beat is older than
+    ``timeout`` seconds. A rank that has never beaten (no file yet) is
+    judged against ``grace`` from ``baseline`` (the group spawn time)
+    instead: imports and the first XLA compile legitimately take tens of
+    seconds before any beat, but a worker wedged *before* its first beat is
+    still eventually caught. The launcher uses a fresh directory per
+    incarnation so a relaunch never inherits the dead group's mtimes."""
+    directory = Path(directory)
+    stale = []
+    for rank in range(nproc):
+        try:
+            last = (directory / f"rank{rank}").stat().st_mtime
+        except OSError:
+            if now - baseline > max(grace, timeout):
+                stale.append(rank)
+            continue
+        if now - max(last, baseline) > timeout:
+            stale.append(rank)
+    return stale

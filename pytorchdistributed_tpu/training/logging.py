@@ -2,14 +2,18 @@
 
 The reference prints from every rank, interleaving output
 (02_ddp.ipynb:252-266). Here: a stdlib logger that only emits on the main
-process, plus a tiny metric formatter. Heavier sinks (TensorBoard via
-`jax.profiler`) attach in utils/profiling.py.
+process, plus a tiny metric formatter, plus an optional machine-readable
+JSONL sink (``jsonl_path`` / Trainer ``metrics_file``) so per-step metrics
+are first-class data, not just console text. Heavier sinks (TensorBoard
+via `jax.profiler`) attach in utils/profiling.py.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 
 import jax
 
@@ -17,7 +21,7 @@ _FMT = "[%(asctime)s rank{rank}] %(message)s"
 
 
 class MetricLogger:
-    def __init__(self, name: str = "tpu-dist"):
+    def __init__(self, name: str = "tpu-dist", jsonl_path: str | None = None):
         self._log = logging.getLogger(name)
         if not self._log.handlers:
             h = logging.StreamHandler(sys.stdout)
@@ -29,6 +33,10 @@ class MetricLogger:
             self._log.addHandler(h)
             self._log.setLevel(logging.INFO)
             self._log.propagate = False
+        # line-buffered append: each step is one durable JSON line even if
+        # the job dies mid-epoch
+        self._jsonl = (open(jsonl_path, "a", buffering=1)
+                       if jsonl_path else None)
 
     def info(self, msg: str) -> None:
         self._log.info(msg)
@@ -36,3 +44,7 @@ class MetricLogger:
     def log_step(self, epoch: int, step: int, metrics: dict[str, float]) -> None:
         parts = " ".join(f"{k}={v:.4g}" for k, v in metrics.items())
         self._log.info(f"epoch {epoch} step {step} | {parts}")
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"time": round(time.time(), 3), "epoch": epoch, "step": step,
+                 **{k: float(v) for k, v in metrics.items()}}) + "\n")

@@ -22,11 +22,14 @@ import optax
 
 
 def _stochastic_kwargs(target, rng):
-    """(kwargs for model.apply) enabling dropout when training: only when
-    the applied method takes ``deterministic`` (the transformer zoo and
-    ViT; ResNet/MLP have no stochastic layers). ``target`` is the callable
-    being applied (a Module's __call__ or a method like
-    loss_per_position)."""
+    """(kwargs for model.apply) selecting train-mode behavior when ``rng``
+    is set: only for methods that take ``deterministic``. That flag now
+    gates more than dropout — ResNet's ``deterministic`` switches its
+    sync-BN between batch statistics (training; feeds the EMA) and the EMA
+    itself (eval), so narrowing this check would silently freeze BN at
+    init stats. MLP/toys have no ``deterministic`` and get no kwargs.
+    ``target`` is the callable being applied (a Module's __call__ or a
+    method like loss_per_position)."""
     if rng is None:
         return {}
     if "deterministic" not in inspect.signature(target).parameters:
@@ -41,14 +44,27 @@ def mse_loss(model, params, batch, rng=None):
 
 
 def cross_entropy_loss(model, params, batch, rng=None):
-    """Image classification: batch = {image, label}."""
-    logits = model.apply(params, batch["image"],
-                         **_stochastic_kwargs(type(model).__call__, rng))
+    """Image classification: batch = {image, label}. When training (rng
+    set), models carrying normalization EMA state (ResNet's "batch_stats")
+    refresh it; the updated collection rides the metrics under
+    "_collections" — the Trainer pops it and folds it into TrainState
+    (the flax mutable-collections train-step pattern)."""
+    kwargs = _stochastic_kwargs(type(model).__call__, rng)
+    mutable = (["batch_stats"]
+               if rng is not None and "batch_stats" in params else [])
+    if mutable:
+        logits, mods = model.apply(params, batch["image"], mutable=mutable,
+                                   **kwargs)
+    else:
+        logits = model.apply(params, batch["image"], **kwargs)
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["label"]
     ).mean()
     acc = (logits.argmax(-1) == batch["label"]).mean()
-    return loss, {"loss": loss, "accuracy": acc}
+    metrics = {"loss": loss, "accuracy": acc}
+    if mutable:
+        metrics["_collections"] = mods
+    return loss, metrics
 
 
 def token_cross_entropy_loss(model, params, batch, rng=None):

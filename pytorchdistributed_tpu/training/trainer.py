@@ -34,6 +34,7 @@ from pytorchdistributed_tpu.data.loader import prefetch_to_device
 from pytorchdistributed_tpu.parallel.precision import Policy
 from pytorchdistributed_tpu.parallel.sharding import shardings_for_strategy
 from pytorchdistributed_tpu.runtime import dist
+from pytorchdistributed_tpu.runtime.heartbeat import Heartbeat
 from pytorchdistributed_tpu.data.loader import shard_batch
 from pytorchdistributed_tpu.runtime.mesh import batch_leaf_sharding, create_mesh
 from pytorchdistributed_tpu.training.logging import MetricLogger
@@ -91,6 +92,7 @@ class Trainer:
         profile_dir: str | None = None,
         batch_adapter: Callable | None = None,
         accum_steps: int = 1,
+        metrics_file: str | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -113,7 +115,10 @@ class Trainer:
             self.checkpoint = CheckpointManager(
                 checkpoint_dir,
                 save_interval_steps=max(checkpoint_every_steps, 1))
-        self.logger = MetricLogger()
+        # metrics_file: rank-0 JSONL sink — per-step metrics as data
+        # (SURVEY.md §5), one durable line per logged step
+        self.logger = MetricLogger(
+            jsonl_path=metrics_file if dist.is_main_process() else None)
         self._loss_fn = loss_fn
         self._batch_adapter = batch_adapter or default_batch_adapter
         self._steps_per_epoch: int | None = None
@@ -122,6 +127,12 @@ class Trainer:
         # check would serialize the hot loop and defeat prefetch overlap)
         # and the full param tree every `state_every` checks.
         self._watchdog = NaNWatchdog() if watchdog else None
+        # Liveness beats for the elastic agent's hung-rank detection
+        # (run.py --heartbeat-timeout); None outside a launcher that asked.
+        # Beats fire where the host BLOCKS on device values (log cadence,
+        # epoch end) — host-loop progress alone proves nothing under async
+        # dispatch (see runtime/heartbeat.py).
+        self._heartbeat = Heartbeat.from_env()
         self._meter = ThroughputMeter()
         self.profile_dir = profile_dir
         self._profiling = False
@@ -155,7 +166,7 @@ class Trainer:
         def make_state(rng, batch):
             with nn.logical_axis_rules(self._rules):
                 variables = self.model.init(rng, *self._model_args(batch))
-            params = nn.meta.unbox(variables)
+            params = nn.meta.unbox(_drop_sown(variables))
             opt_state = self.optimizer.init(params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32), params=params,
@@ -186,6 +197,7 @@ class Trainer:
                 lambda r, b: self.model.init(r, *self._model_args(b)),
                 rng, sample_batch,
             )
+        abstract_boxed = _drop_sown(abstract_boxed)
         abstract_params = nn.meta.unbox(abstract_boxed)
         abstract = TrainState(
             step=jax.ShapeDtypeStruct((), jnp.int32),
@@ -263,7 +275,12 @@ class Trainer:
                 # for one micro-batch alive at a time), fp32-accumulated
                 # grads averaged before the single optimizer update — the
                 # large-batch recipe when the full batch's activations
-                # exceed HBM.
+                # exceed HBM. Exactness caveat (ADVICE r2): equal-weight
+                # averaging reproduces the full-batch loss exactly for
+                # unmasked CE/MSE; for masked losses (MLM loss_mask) each
+                # micro-batch normalizes by its own mask count, so accum>1
+                # approximates the global masked mean when mask counts vary
+                # across micro-batches.
                 def as_microbatches(leaf):
                     b = leaf.shape[0]
                     if b % accum:
@@ -288,7 +305,18 @@ class Trainer:
                 grads, metrics = jax.lax.scan(
                     body, g0, (mbs, jnp.arange(accum)))
                 grads = jax.tree.map(lambda g: g / accum, grads)
-                metrics = jax.tree.map(lambda m: m.mean(), metrics)
+                # scalar metrics mean over micro-batches; for "_collections"
+                # the mean of per-micro-batch EMA updates is itself one
+                # valid EMA step (each is m·base + (1-m)·stat_i)
+                metrics = jax.tree.map(lambda m: m.mean(0), metrics)
+            # Mutable-collection updates (ResNet batch_stats EMA) ride the
+            # metrics; they are STATE, not a scalar — fold into params after
+            # the optimizer step (whose update for them is overwritten).
+            # Deliberate tradeoff: the stats stay inside the optimizer tree
+            # (a few hundred KB of dead slots) because masking them out
+            # (optax.masked) would wrap the opt-state pytree and defeat
+            # _opt_state_shardings' structural param-mirroring under FSDP.
+            new_colls = metrics.pop("_collections", None)
             # Grads arrive in compute dtype; master update stays fp32.
             grads = jax.tree.map(
                 lambda g, p: g.astype(p.dtype), grads, state.params
@@ -297,6 +325,8 @@ class Trainer:
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
+            if new_colls is not None:
+                params = {**params, **new_colls}
             new_state = TrainState(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
@@ -329,47 +359,60 @@ class Trainer:
                 f".pipeline_parts() (the pre/stages/head decomposition); "
                 f"use pp_schedule='gpipe' for models without one")
         cfg = self._transformer_cfg()
-        if cfg.dropout_rate > 0:
-            raise NotImplementedError(
-                "dropout inside the 1f1b pipelined stack is not supported yet")
-        if getattr(cfg, "moe_experts", 0) > 0:
-            # The fused schedule runs block.apply without mutable
-            # collections, so the sown load-balance aux loss would be
-            # silently dropped — refuse rather than train a collapsing
-            # router.
-            raise NotImplementedError(
-                "moe_experts > 0 with pp_schedule='1f1b' is not supported "
-                "yet (the Switch aux loss cannot ride the fused pipeline); "
-                "use pp_schedule='gpipe'")
         from pytorchdistributed_tpu.training.losses import (
+            MOE_AUX_WEIGHT,
+            fused_token_cross_entropy_loss,
+            moe_token_cross_entropy_loss,
             token_cross_entropy_loss,
         )
-        if self._loss_fn is not token_cross_entropy_loss:
+        if self._loss_fn not in (token_cross_entropy_loss,
+                                 fused_token_cross_entropy_loss,
+                                 moe_token_cross_entropy_loss):
             # The fused step computes loss inside the pipeline's last stage
             # (model.pipeline_parts().head_loss) — the Trainer-level loss_fn
-            # cannot be threaded through it.
-            self.logger.info(
-                "WARNING: pp_schedule='1f1b' uses the model's fused "
-                f"head_loss; the custom loss_fn "
+            # cannot be threaded through it. Raise rather than warn: a user
+            # who passed a custom objective would otherwise train a
+            # different one.
+            raise ValueError(
+                f"pp_schedule='1f1b' computes its loss inside the pipeline "
+                f"(model.pipeline_parts().head_loss); the custom loss_fn "
                 f"{getattr(self._loss_fn, '__name__', self._loss_fn)!r} "
-                f"is ignored")
+                f"cannot be threaded through the fused schedule — use the "
+                f"built-in token CE losses or pp_schedule='gpipe'")
         parts = self.model.pipeline_parts()
         policy = self.precision
+        use_aux = getattr(cfg, "moe_experts", 0) > 0
+        if use_aux and parts.stage_apply_aux is None:
+            raise ValueError(
+                f"moe_experts > 0 with pp_schedule='1f1b' needs "
+                f"{type(self.model).__name__}.pipeline_parts() to provide "
+                f"stage_apply_aux (the Switch aux loss must ride the fused "
+                f"pipeline)")
+        stage_fn = parts.stage_apply_aux if use_aux else parts.stage_apply
+        # loss convention matches moe_token_cross_entropy_loss: ce +
+        # MOE_AUX_WEIGHT · mean-over-layers(aux); stage_apply_aux sums over
+        # layers, so fold the 1/L in here.
+        aux_weight = MOE_AUX_WEIGHT / cfg.num_layers if use_aux else 0.0
+        train_dropout = cfg.dropout_rate > 0
 
         def step(state: TrainState, batch):
             cparams = policy.cast_params_for_compute(state.params)
             targets = (parts.targets_of(batch) if parts.targets_of
                        else batch["targets"])
+            dropout_rng = (
+                jax.random.fold_in(jax.random.key(1_234_567), state.step)
+                if train_dropout else None)
             with nn.logical_axis_rules(self._rules):
                 pre_p, stage_p, head_p = parts.split(cparams)
                 x, pre_vjp = jax.vjp(
                     lambda pp: parts.pre_apply(pp, *self._model_args(batch)),
                     pre_p)
                 loss, stage_g, head_g, dx = one_f_one_b(
-                    parts.stage_apply, stage_p, parts.head_loss, head_p,
+                    stage_fn, stage_p, parts.head_loss, head_p,
                     x, targets,
                     num_microbatches=cfg.pipeline_microbatches,
-                    mesh=self.mesh)
+                    mesh=self.mesh, dropout_rng=dropout_rng,
+                    aux_weight=aux_weight)
                 (pre_g,) = pre_vjp(dx)
                 grads = parts.merge_grads(pre_g, stage_g, head_g)
             grads = jax.tree.map(
@@ -437,6 +480,8 @@ class Trainer:
             self._meter.update(self._batch_samples(batch))
             if (i + 1) % self.log_every == 0:
                 vals = {k: float(v) for k, v in metrics.items()}
+                if self._heartbeat is not None:  # we just synced the device
+                    self._heartbeat.beat()
                 if self._watchdog is not None:
                     self._watchdog.check(vals, self.state)
                 rate = self._meter.rate
@@ -448,7 +493,10 @@ class Trainer:
                     and (i + 1) % self._checkpoint_every == 0):
                 self._save_checkpoint()
         self._maybe_profile(epoch, -1)  # close an open capture at epoch end
-        return {k: float(v) for k, v in metrics.items()}
+        out = {k: float(v) for k, v in metrics.items()}
+        if self._heartbeat is not None:  # epoch-end device sync
+            self._heartbeat.beat()
+        return out
 
     # -- evaluation --------------------------------------------------------
 
@@ -469,7 +517,11 @@ class Trainer:
                                                None)
                 return {k: v.astype(jnp.float32) for k, v in metrics.items()}
 
-            self._eval_fn = jax.jit(estep)
+            # Explicit in_shardings, same contract as the train step: a
+            # mismatched-layout batch errors instead of silently re-laying
+            # out (params side reuses the state shardings).
+            self._eval_fn = jax.jit(
+                estep, in_shardings=(self.state_shardings.params, None))
         if any(not isinstance(v, jax.Array) for v in batch.values()):
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):
@@ -483,8 +535,13 @@ class Trainer:
         every sample is scored). The epoch is pinned to 0 so successive
         evaluate() calls score the SAME subset in the same order — val
         curves stay comparable across epochs; prefer shuffle=False val
-        loaders. The reference has no eval loop at all; this is the
-        missing half of its Trainer."""
+        loaders. Multi-replica caveat (ADVICE r2, same as torch's
+        DistributedSampler): with drop_last=False the sampler pads ranks
+        to equal count by repeating head indices, and those duplicates ARE
+        counted in the mean — an O(replicas/len) skew; use a
+        single-replica val loader when exactness matters. The reference
+        has no eval loop at all; this is the missing half of its
+        Trainer."""
         totals: dict = {}
         count = 0
         loader.set_epoch(0)
@@ -661,6 +718,14 @@ class Trainer:
             self.logger.info(f"resumed from step {step} "
                              f"(epoch {start_epoch}, skipping {skip})")
         return start_epoch, skip
+
+
+def _drop_sown(variables):
+    """Strip the "losses" collection a `model.init` may have sown (Switch-MoE
+    aux values): it is per-batch OUTPUT, not state — keeping it in
+    TrainState would allocate optimizer slots for it and break the 1F1B
+    grad merge (pipeline_parts grads cover "params" only)."""
+    return {k: v for k, v in variables.items() if k != "losses"}
 
 
 def _opt_state_shardings(abstract_opt_state, abstract_params, param_shardings,

@@ -311,6 +311,15 @@ def test_mlm_dataset_contract():
     # negative indices alias their positive counterparts (numpy-style)
     last = ds[len(ds) - 1]
     np.testing.assert_array_equal(ds[-1]["tokens"], last["tokens"])
+    # per-SAMPLE determinism (ADVICE r2): a sample's mask depends only on
+    # (seed, index), not on which other indices share the fetch — val
+    # losses comparable across batch sizes / replica counts
+    a01 = ds[np.array([0, 1])]
+    a05 = ds[np.array([0, 5])]
+    solo = ds[0]
+    np.testing.assert_array_equal(a01["tokens"][0], a05["tokens"][0])
+    np.testing.assert_array_equal(a01["tokens"][0], solo["tokens"])
+    np.testing.assert_array_equal(a01["loss_mask"][0], solo["loss_mask"])
 
 
 def test_bert_preset_uses_mlm_masking():

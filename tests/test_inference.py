@@ -54,6 +54,25 @@ def test_llama_greedy_matches_dense():
                         cfg.vocab_size)
 
 
+def test_decode_attend_window_bounds_cost_not_output():
+    """generate() bounds per-tick attention to the (128-rounded)
+    prompt+new total (cfg.decode_attend_len) instead of max_seq_len. At
+    max_seq_len=512 with a 13-token sequence the window is 128 — and the
+    output must still match the uncached model exactly (RoPE params are
+    max_seq_len-independent, so the same check as _greedy_consistency
+    covers the windowed path)."""
+    cfg = llama_config("test", max_seq_len=512)
+    decode_model = Llama(dataclasses.replace(cfg, decode=True))
+    _greedy_consistency(Llama(cfg), decode_model, cfg.vocab_size)
+
+
+def test_decode_non_dense_attention_warns():
+    """The training-time attention backend knob does not apply to decode;
+    building a decode config with one must say so (ADVICE r2)."""
+    with pytest.warns(UserWarning, match="attention"):
+        gpt2_config("test", decode=True, attention="pallas")
+
+
 def test_gpt2_unrolled_layers_decode():
     cfg = gpt2_config("test", num_layers=2, max_seq_len=32, scan_layers=False)
     _greedy_consistency(GPT2(cfg), GPT2(dataclasses.replace(cfg, decode=True)),

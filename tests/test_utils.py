@@ -60,3 +60,21 @@ def test_scaling_efficiency():
     assert scaling_efficiency(800.0, 100.0, 8) == pytest.approx(1.0)
     assert scaling_efficiency(720.0, 100.0, 8) == pytest.approx(0.9)
     assert np.isnan(scaling_efficiency(1.0, 0.0, 8))
+
+
+def test_metric_logger_jsonl_sink(tmp_path):
+    """metrics_file: per-step metrics land as machine-readable JSONL
+    (SURVEY.md §5 'per-step metrics as first-class data')."""
+    import json
+
+    from pytorchdistributed_tpu.training.logging import MetricLogger
+
+    path = tmp_path / "metrics.jsonl"
+    lg = MetricLogger(name="jsonl-test", jsonl_path=str(path))
+    lg.log_step(0, 10, {"loss": 1.5, "accuracy": 0.25})
+    lg.log_step(0, 20, {"loss": 1.25})
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[0]["step"] == 10 and rows[0]["loss"] == 1.5
+    assert rows[0]["accuracy"] == 0.25 and "time" in rows[0]
+    assert rows[1]["epoch"] == 0 and rows[1]["step"] == 20
