@@ -1,0 +1,112 @@
+"""Device-time summaries of `jax.profiler` captures (SURVEY.md §5
+"Tracing / profiling").
+
+The Trainer's ``profile_dir`` writes a Perfetto trace; this module answers
+the first question anyone asks of it — *where did the step time go?* —
+without leaving the terminal:
+
+    python -m pytorchdistributed_tpu.utils.trace /tmp/profile [--steps 3]
+
+It aggregates the TPU "XLA Ops" track by op family (fusion kinds, Pallas
+custom-calls, copies, while-loops...) and prints a per-step table plus the
+top individual ops. This is the exact workflow that found the round-3 MFU
+wins (latency-bound attention grids, GQA repeat copies): keep the trace
+window small (the Trainer captures steps 2-7) and divide by the step count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+
+
+def load_trace_events(profile_dir: str) -> list[dict]:
+    """Events of the newest ``*.trace.json.gz`` under ``profile_dir``
+    (searching the plugins/profile/<run>/ layout jax.profiler writes)."""
+    paths = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {profile_dir!r} — point at the "
+            f"directory passed to Trainer(profile_dir=...) / "
+            f"jax.profiler.trace")
+    with gzip.open(paths[-1], "rt") as f:
+        return json.load(f)["traceEvents"]
+
+
+def device_op_durations(events: list[dict]) -> dict[str, tuple[float, int]]:
+    """{op name: (total us, count)} over every device's "XLA Ops" thread.
+    Note XLA nests some regions (a while-loop's body ops are also emitted
+    as their own events), so the grand total can exceed wall time — the
+    table answers "which ops are hot", not "what sums to 100%"."""
+    pids = {e["pid"]: e["args"].get("name", "") for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tids = {(e["pid"], e["tid"]): e["args"].get("name", "") for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    out: dict[str, list] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if "TPU" not in pids.get(e["pid"], ""):
+            continue
+        if tids.get((e["pid"], e["tid"])) != "XLA Ops":
+            continue
+        r = out.setdefault(e["name"], [0.0, 0])
+        r[0] += e.get("dur", 0)
+        r[1] += 1
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def family(op_name: str) -> str:
+    """Strip the trailing instruction numbering: ``fusion.123`` →
+    ``fusion``, ``multiply_reduce_fusion.5`` → ``multiply_reduce_fusion``."""
+    return re.sub(r"[.\d]+$", "", op_name)
+
+
+def summarize(profile_dir: str, *, steps: int = 1,
+              top: int = 15) -> str:
+    """Human-readable per-family and top-ops tables (``steps`` divides the
+    totals so numbers read as ms/step)."""
+    ops = device_op_durations(load_trace_events(profile_dir))
+    fams: collections.Counter = collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    for name, (dur, n) in ops.items():
+        fams[family(name)] += dur
+        counts[family(name)] += n
+    total = sum(fams.values())
+    lines = [f"device op time: {total / steps / 1e3:.1f} ms/step "
+             f"(x{steps} steps; nested regions double-count)"]
+    lines.append(f"{'share':>6}  {'ms/step':>9}  {'calls':>6}  op family")
+    for fam, dur in fams.most_common(top):
+        lines.append(f"{dur / total * 100:5.1f}%  {dur / steps / 1e3:9.2f}"
+                     f"  {counts[fam]:6d}  {fam}")
+    lines.append("")
+    lines.append(f"{'ms/step':>9}  {'calls':>6}  top individual ops")
+    for name, (dur, n) in sorted(ops.items(), key=lambda kv: -kv[1][0])[:top]:
+        lines.append(f"{dur / steps / 1e3:9.2f}  {n:6d}  {name}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "pytorchdistributed_tpu.utils.trace",
+        description="summarize a jax.profiler capture's device time")
+    p.add_argument("profile_dir")
+    p.add_argument("--steps", type=int, default=1,
+                   help="steps inside the capture window (Trainer's "
+                        "profile_dir captures 6)")
+    p.add_argument("--top", type=int, default=15)
+    args = p.parse_args(argv)
+    print(summarize(args.profile_dir, steps=args.steps, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

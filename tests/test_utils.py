@@ -78,3 +78,55 @@ def test_metric_logger_jsonl_sink(tmp_path):
     assert rows[0]["step"] == 10 and rows[0]["loss"] == 1.5
     assert rows[0]["accuracy"] == 0.25 and "time" in rows[0]
     assert rows[1]["epoch"] == 0 and rows[1]["step"] == 20
+
+
+def test_trace_summary(tmp_path):
+    """utils.trace summarizes a jax.profiler capture's device time by op
+    family (the Trainer's profile_dir consumer)."""
+    import gzip
+    import json
+
+    from pytorchdistributed_tpu.utils.trace import summarize
+
+    run = tmp_path / "plugins" / "profile" / "2026_01_01"
+    run.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 3, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "name": "thread_name", "pid": 9, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        # device ops: two fusions of one family, one custom-call
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1", "dur": 3000},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.2", "dur": 1000},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "attn.7", "dur": 2000},
+        # host op must be ignored
+        {"ph": "X", "pid": 9, "tid": 1, "name": "hostwork.1", "dur": 9999},
+    ]
+    with gzip.open(run / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    out = summarize(str(tmp_path), steps=2)
+    assert "fusion" in out and "attn" in out
+    assert "hostwork" not in out
+    # 4000us fusion over 2 steps -> 2.00 ms/step
+    assert "2.00" in out and "3.0 ms/step" in out
+
+
+def test_bf16_policy_preserves_batch_stats():
+    """Mixed precision must not quantize normalization running statistics:
+    the EMA update reads its fp32 master every step, so casting
+    batch_stats to bf16 would accumulate per-step quantization noise in
+    the eval stats (torch amp's BN rule)."""
+    from pytorchdistributed_tpu.parallel import Policy
+
+    params = {
+        "params": {"w": jnp.ones((4, 4), jnp.float32)},
+        "batch_stats": {"bn": {"mean": jnp.ones((4,), jnp.float32)}},
+    }
+    cast = Policy.bf16().cast_params_for_compute(params)
+    assert cast["params"]["w"].dtype == jnp.bfloat16
+    assert cast["batch_stats"]["bn"]["mean"].dtype == jnp.float32
