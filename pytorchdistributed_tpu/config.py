@@ -77,6 +77,7 @@ class ExperimentConfig:
     resume: bool = False
     log_every: int = 10
     profile_dir: str = ""          # capture a jax.profiler trace here
+    metrics_file: str = ""         # rank-0 JSONL per-step metrics sink
     watchdog: bool = True          # NaN/Inf watchdog at log cadence
 
 
@@ -408,6 +409,7 @@ def make_trainer(cfg: ExperimentConfig):
         checkpoint_every_steps=cfg.checkpoint_every_steps,
         watchdog=cfg.watchdog,
         profile_dir=cfg.profile_dir or None,
+        metrics_file=cfg.metrics_file or None,
         accum_steps=cfg.accum_steps,
     )
     return trainer, loader

@@ -81,8 +81,10 @@ class TransformerConfig:
     # outputs of weight matmuls (dot_generals with no batch dims — the
     # q/k/v/o projections and both MLP matmuls) and recomputes only
     # elementwise ops and attention internals: nearly the memory win at a
-    # few percent recompute cost, the MFU-friendly default.
-    remat_policy: str = "dots"          # full | dots | dots_all
+    # few percent recompute cost, the MFU-friendly default. "dots_norms"
+    # additionally keeps the bf16 post-norm activations (see
+    # checkpoint_policy).
+    remat_policy: str = "dots"    # full | dots | dots_all | dots_norms
     tie_embeddings: bool = True
     # Pipeline parallelism (parallel/pipeline.py): >1 runs the stack as a
     # pipeline over the "pipe" mesh axis with this many stages.
@@ -170,6 +172,15 @@ def checkpoint_policy(name: str):
             cp.dots_with_no_batch_dims_saveable, attn_saved),
         "dots_all": cp.save_from_both_policies(
             cp.dots_saveable, attn_saved),
+        # dots_all + the bf16 post-norm activations (norm_out, named in
+        # TransformerBlock): trades one bf16 activation of HBM per norm
+        # for skipping the fp32-upcast + cross-lane-reduce norm recompute
+        # the r3 profile put at ~10% of the Llama-1B step. Unmeasured on
+        # hardware as of r3 (chip access dropped) — benchmark before
+        # making it a default.
+        "dots_norms": cp.save_from_both_policies(
+            cp.dots_saveable,
+            cp.save_only_these_names("attn_out", "attn_lse", "norm_out")),
     }
     if name not in policies:
         raise ValueError(
@@ -460,9 +471,14 @@ class TransformerBlock(nn.Module):
         cfg = self.cfg
         x = nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
-        h = _layer_norm(cfg, "ln1")(x).astype(cfg.dtype)
+
+        def norm(tag, v):  # named so remat policies can keep it (bf16)
+            return jax.ad_checkpoint.checkpoint_name(
+                _layer_norm(cfg, tag)(v).astype(cfg.dtype), "norm_out")
+
+        h = norm("ln1", x)
         x = x + SelfAttention(cfg, self.deterministic, name="attn")(h)
-        h = _layer_norm(cfg, "ln2")(x).astype(cfg.dtype)
+        h = norm("ln2", x)
         if cfg.moe_experts > 0:
             from pytorchdistributed_tpu.models.moe import SwitchMoE
 

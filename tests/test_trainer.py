@@ -295,3 +295,26 @@ def test_accum_composes_with_fsdp():
         losses[accum] = [float(tr.train_step(batch)["loss"])
                          for _ in range(3)]
     np.testing.assert_allclose(losses[1], losses[4], rtol=2e-4, atol=1e-6)
+
+
+def test_trainer_beats_heartbeat_at_device_sync(tmp_path, monkeypatch):
+    """The launcher-side watchdog is only as good as the Trainer's beats:
+    with PTD_HEARTBEAT_DIR exported (run.py --heartbeat-timeout), run_epoch
+    must stamp this rank's liveness file at its device-sync points."""
+    import time as _time
+
+    from pytorchdistributed_tpu.runtime.heartbeat import HEARTBEAT_DIR_ENV
+
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("RANK", "0")
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=local_mesh(1), log_every=2, watchdog=False)
+    rank_file = tmp_path / "rank0"
+    assert not rank_file.exists()  # no beat before real progress (grace
+    #                                covers imports + first compile)
+    tr.run_epoch(_make_loader(batch_size=16), epoch=0)
+    assert rank_file.exists()
+    first = rank_file.stat().st_mtime
+    _time.sleep(0.05)
+    tr.run_epoch(_make_loader(batch_size=16), epoch=1)
+    assert rank_file.stat().st_mtime > first  # keeps beating epoch over epoch
