@@ -8,7 +8,6 @@ data/datasets.py's MLM shape: {tokens, targets, loss_mask}.
 from __future__ import annotations
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
@@ -17,8 +16,11 @@ from pytorchdistributed_tpu.models.transformer import (
     TransformerStack,
     _dense_general,
     _layer_norm,
+    check_pipeline_decomposition,
     gather_free_ce,
     make_stage_apply,
+    stack_to_stages,
+    stages_to_stack,
 )
 from pytorchdistributed_tpu.parallel.tp import Logical
 
@@ -56,19 +58,12 @@ class BertMLM(nn.Module):
         from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
 
         cfg = self.cfg
-        p = cfg.pipeline_stages
         m = cfg.pipeline_microbatches
-        if cfg.num_layers % p:
-            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
-                             f"pipeline_stages {p}")
-        if not cfg.scan_layers:
-            raise ValueError("pipeline_parts requires scan_layers=True")
+        check_pipeline_decomposition(cfg)
 
         def split(params):
             pp = params["params"]
-            stage = jax.tree.map(
-                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
-                pp["encoder"]["block"])
+            stage = stack_to_stages(pp["encoder"]["block"], cfg)
             head = {"mlm_dense": pp["mlm_dense"], "mlm_ln": pp["mlm_ln"],
                     "proj": pp["embed"]["tok"]["embedding"]}
             pre = {"embed": pp["embed"], "ln_embed": pp["ln_embed"]}
@@ -100,8 +95,7 @@ class BertMLM(nn.Module):
             return (ce * t["w"]).sum() * m
 
         def merge_grads(pre_g, stage_g, head_g):
-            blocks = jax.tree.map(
-                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            blocks = stages_to_stack(stage_g, cfg)
             embed_g = dict(pre_g["embed"])
             tok = embed_g["tok"]
             embed_g["tok"] = {"embedding": tok["embedding"] + head_g["proj"]}

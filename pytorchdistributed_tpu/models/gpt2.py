@@ -10,7 +10,6 @@ replacement, built on the shared TransformerStack so every parallel strategy
 from __future__ import annotations
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
@@ -19,7 +18,10 @@ from pytorchdistributed_tpu.models.transformer import (
     TransformerConfig,
     TransformerStack,
     _layer_norm,
+    check_pipeline_decomposition,
     make_stage_apply,
+    stack_to_stages,
+    stages_to_stack,
 )
 
 
@@ -77,18 +79,11 @@ class GPT2(nn.Module):
         from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
 
         cfg = self.cfg
-        p = cfg.pipeline_stages
-        if cfg.num_layers % p:
-            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
-                             f"pipeline_stages {p}")
-        if not cfg.scan_layers:
-            raise ValueError("pipeline_parts requires scan_layers=True")
+        check_pipeline_decomposition(cfg)
 
         def split(params):
             pp = params["params"]
-            stage = jax.tree.map(
-                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
-                pp["h"]["block"])
+            stage = stack_to_stages(pp["h"]["block"], cfg)
             head = {"ln_f": pp["ln_f"]}
             head["proj"] = (pp["embed"]["tok"]["embedding"]
                             if cfg.tie_embeddings
@@ -110,8 +105,7 @@ class GPT2(nn.Module):
             return gather_free_ce(logits, targets).mean()
 
         def merge_grads(pre_g, stage_g, head_g):
-            blocks = jax.tree.map(
-                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            blocks = stages_to_stack(stage_g, cfg)
             tree = {"embed": pre_g, "h": {"block": blocks},
                     "ln_f": head_g["ln_f"]}
             if cfg.tie_embeddings:

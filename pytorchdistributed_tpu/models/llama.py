@@ -11,7 +11,6 @@ device_map analog (parallel/auto.py) — applies unmodified.
 from __future__ import annotations
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from pytorchdistributed_tpu.models.transformer import (
@@ -20,8 +19,11 @@ from pytorchdistributed_tpu.models.transformer import (
     TransformerConfig,
     TransformerStack,
     _layer_norm,
+    check_pipeline_decomposition,
     gather_free_ce,
     make_stage_apply,
+    stack_to_stages,
+    stages_to_stack,
 )
 
 
@@ -63,18 +65,11 @@ class Llama(nn.Module):
         from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
 
         cfg = self.cfg
-        p = cfg.pipeline_stages
-        if cfg.num_layers % p:
-            raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
-                             f"pipeline_stages {p}")
-        if not cfg.scan_layers:
-            raise ValueError("pipeline_parts requires scan_layers=True")
+        check_pipeline_decomposition(cfg)
 
         def split(params):
             pp = params["params"]
-            stage = jax.tree.map(
-                lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]),
-                pp["h"]["block"])
+            stage = stack_to_stages(pp["h"]["block"], cfg)
             head = {"ln_f": pp["ln_f"], "proj": pp["lm_head"]["kernel"]}
             return pp["embed"], stage, head
 
@@ -87,8 +82,7 @@ class Llama(nn.Module):
             return gather_free_ce(logits, targets).mean()
 
         def merge_grads(pre_g, stage_g, head_g):
-            blocks = jax.tree.map(
-                lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_g)
+            blocks = stages_to_stack(stage_g, cfg)
             return {"params": {
                 "embed": pre_g, "h": {"block": blocks},
                 "ln_f": head_g["ln_f"],

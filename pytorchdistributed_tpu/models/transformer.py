@@ -489,6 +489,32 @@ class TransformerBlock(nn.Module):
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 
 
+def check_pipeline_decomposition(cfg: TransformerConfig) -> int:
+    """Shared pipeline_parts validation (GPT-2/Llama/BERT/ViT): returns the
+    stage count after checking the scanned layout divides into it."""
+    p = cfg.pipeline_stages
+    if cfg.num_layers % p:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                         f"pipeline_stages {p}")
+    if not cfg.scan_layers:
+        raise ValueError("pipeline_parts requires scan_layers=True")
+    return p
+
+
+def stack_to_stages(blocks, cfg: TransformerConfig):
+    """[L, ...]-stacked block params → [P, L/P, ...] stage groups
+    (contiguous layers per stage, matching the stage-axis sharding)."""
+    p = cfg.pipeline_stages
+    return jax.tree.map(
+        lambda a: a.reshape(p, cfg.num_layers // p, *a.shape[1:]), blocks)
+
+
+def stages_to_stack(stage_grads, cfg: TransformerConfig):
+    """Inverse of stack_to_stages for the gradient merge."""
+    return jax.tree.map(
+        lambda a: a.reshape(cfg.num_layers, *a.shape[2:]), stage_grads)
+
+
 def make_stage_apply(cfg: TransformerConfig, *, aux: bool = False):
     """Build the pipeline stage body shared by the GPipe apply path
     (TransformerStack._pipelined) and the models' 1F1B ``pipeline_parts``:

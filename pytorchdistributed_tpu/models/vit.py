@@ -3,7 +3,9 @@ across pod slices").
 
 Patchify via a strided Conv (one big matmul for the MXU, NHWC layout),
 prepend a CLS token, run the shared bidirectional TransformerStack, classify
-from the CLS representation.
+from the CLS representation. The patchify front-end is its own module
+(`PatchEmbed`) so the 1F1B pipeline decomposition can apply it as the
+pre-stage, mirroring GPT-2/Llama/BERT's ``pipeline_parts`` shape.
 """
 
 from __future__ import annotations
@@ -12,11 +14,16 @@ import dataclasses
 
 import flax.linen as nn
 import jax.numpy as jnp
+import optax
 
 from pytorchdistributed_tpu.models.transformer import (
     TransformerConfig,
     TransformerStack,
     _layer_norm,
+    check_pipeline_decomposition,
+    make_stage_apply,
+    stack_to_stages,
+    stages_to_stack,
 )
 from pytorchdistributed_tpu.parallel.tp import Logical
 
@@ -33,11 +40,14 @@ class ViTConfig:
         return (self.image_size // self.patch_size) ** 2
 
 
-class ViT(nn.Module):
+class PatchEmbed(nn.Module):
+    """images [B, H, W, C] → tokens [B, num_patches+1, embed]: strided-conv
+    patchify + CLS token + learned positions (everything before block 0)."""
+
     cfg: ViTConfig
 
     @nn.compact
-    def __call__(self, images, *, deterministic: bool = True):
+    def __call__(self, images):
         cfg, tcfg = self.cfg, self.cfg.transformer
         p = cfg.patch_size
         x = nn.Conv(
@@ -67,18 +77,73 @@ class ViT(nn.Module):
                 nn.initializers.normal(stddev=0.02), (None, Logical.EMBED)),
             (cfg.num_patches + 1, tcfg.embed_dim), tcfg.param_dtype,
         )
-        x = x + pos[None].astype(tcfg.dtype)
+        return x + pos[None].astype(tcfg.dtype)
 
+
+def _head_dense(cfg: ViTConfig):
+    return nn.Dense(
+        cfg.num_classes, dtype=jnp.float32,
+        param_dtype=cfg.transformer.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (Logical.EMBED, None)),
+        name="head",
+    )
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, *, deterministic: bool = True):
+        cfg, tcfg = self.cfg, self.cfg.transformer
+        x = PatchEmbed(cfg, name="embed")(images)
         x = TransformerStack(tcfg, name="encoder")(
             x, deterministic=deterministic)
         x = _layer_norm(tcfg, "ln_f")(x)
-        logits = nn.Dense(
-            cfg.num_classes, dtype=jnp.float32, param_dtype=tcfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (Logical.EMBED, None)),
-            name="head",
-        )(x[:, 0])
-        return logits
+        return _head_dense(cfg)(x[:, 0])
+
+    @nn.nowrap
+    def pipeline_parts(self):
+        """1F1B decomposition (see GPT2.pipeline_parts): pre = PatchEmbed,
+        stages = encoder layer groups, head = ln_f + CLS classifier + CE
+        over integer labels (``targets_of`` reads batch["label"] — the
+        image-classification batch contract)."""
+        from pytorchdistributed_tpu.parallel.pipeline import PipelineParts
+
+        cfg, tcfg = self.cfg, self.cfg.transformer
+        check_pipeline_decomposition(tcfg)
+
+        def split(params):
+            pp = params["params"]
+            stage = stack_to_stages(pp["encoder"]["block"], tcfg)
+            head = {"ln_f": pp["ln_f"], "head": pp["head"]}
+            return pp["embed"], stage, head
+
+        def pre_apply(pre, images):
+            return PatchEmbed(cfg).apply({"params": pre}, images)
+
+        def targets_of(batch):
+            return batch["label"]
+
+        def head_loss(head, h, labels):
+            x = _layer_norm(tcfg, None).apply({"params": head["ln_f"]}, h)
+            logits = _head_dense(cfg).apply({"params": head["head"]},
+                                            x[:, 0])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+
+        def merge_grads(pre_g, stage_g, head_g):
+            blocks = stages_to_stack(stage_g, tcfg)
+            return {"params": {
+                "embed": pre_g, "encoder": {"block": blocks},
+                "ln_f": head_g["ln_f"], "head": head_g["head"],
+            }}
+
+        return PipelineParts(
+            split, pre_apply, make_stage_apply(tcfg), head_loss,
+            merge_grads, targets_of,
+            stage_apply_aux=(make_stage_apply(tcfg, aux=True)
+                             if tcfg.moe_experts > 0 else None))
 
 
 def vit_config(size: str = "base", *, image_size: int = 224,

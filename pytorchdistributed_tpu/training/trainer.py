@@ -361,13 +361,15 @@ class Trainer:
         cfg = self._transformer_cfg()
         from pytorchdistributed_tpu.training.losses import (
             MOE_AUX_WEIGHT,
+            cross_entropy_loss,
             fused_token_cross_entropy_loss,
             moe_token_cross_entropy_loss,
             token_cross_entropy_loss,
         )
         if self._loss_fn not in (token_cross_entropy_loss,
                                  fused_token_cross_entropy_loss,
-                                 moe_token_cross_entropy_loss):
+                                 moe_token_cross_entropy_loss,
+                                 cross_entropy_loss):
             # The fused step computes loss inside the pipeline's last stage
             # (model.pipeline_parts().head_loss) — the Trainer-level loss_fn
             # cannot be threaded through it. Raise rather than warn: a user
@@ -380,6 +382,13 @@ class Trainer:
                 f"cannot be threaded through the fused schedule — use the "
                 f"built-in token CE losses or pp_schedule='gpipe'")
         parts = self.model.pipeline_parts()
+        if self._loss_fn is cross_entropy_loss and dist.is_main_process():
+            # the fused head computes loss only — the sequential path's
+            # extra metrics (accuracy) don't ride the pipeline
+            self.logger.info(
+                "pp_schedule='1f1b' reports {'loss'} only; accuracy and "
+                "other auxiliary metrics are not computed inside the fused "
+                "pipeline (use evaluate() for them)")
         policy = self.precision
         use_aux = getattr(cfg, "moe_experts", 0) > 0
         if use_aux and parts.stage_apply_aux is None:

@@ -210,16 +210,51 @@ def test_1f1b_validations():
     with pytest.raises(ValueError, match="scan_layers"):
         model.pipeline_parts()
     # models without a pipeline decomposition reject the 1f1b step builder
-    from pytorchdistributed_tpu.models import ViT, vit_config
+    import dataclasses
 
-    vit = ViT(vit_config("test", image_size=32, patch_size=8, num_classes=10,
-                         pipeline_stages=2, pp_schedule="1f1b"))
-    tr = Trainer(vit, optax.sgd(1e-2), token_cross_entropy_loss,
+    from pytorchdistributed_tpu.models.resnet import ResNet, ResNetConfig
+    from pytorchdistributed_tpu.training import cross_entropy_loss
+
+    @dataclasses.dataclass(frozen=True)
+    class _PipeResNetConfig(ResNetConfig):
+        # pipeline knobs so the Trainer picks the 1f1b builder; ResNet
+        # itself has no pipeline_parts() decomposition
+        pipeline_stages: int = 2
+        pp_schedule: str = "1f1b"
+        dropout_rate: float = 0.0
+
+    resnet = ResNet(_PipeResNetConfig(num_classes=10, cifar_stem=True,
+                                      stage_sizes=(1, 1), bottleneck=False))
+    tr = Trainer(resnet, optax.sgd(1e-2), cross_entropy_loss,
                  mesh=create_mesh(data=4, pipe=2), strategy="dp")
     batch = {"image": np.zeros((8, 32, 32, 3), np.float32),
              "label": np.zeros((8,), np.int32)}
     with pytest.raises(ValueError, match="pipeline_parts"):
         tr.train_step(batch)
+
+
+def test_vit_1f1b_loss_equivalence():
+    """ViT rides the fused 1F1B schedule too (PatchEmbed pre-stage, CLS
+    classifier head): pipelined loss curve == sequential."""
+    from pytorchdistributed_tpu.models import ViT, vit_config
+    from pytorchdistributed_tpu.training import cross_entropy_loss
+
+    rng = np.random.default_rng(12)
+    batch = {"image": rng.standard_normal((16, 32, 32, 3)).astype(np.float32),
+             "label": rng.integers(0, 10, (16,)).astype(np.int32)}
+
+    def run(cfg_kw, axes):
+        model = ViT(vit_config("test", image_size=32, patch_size=8,
+                               num_classes=10, num_layers=4,
+                               dtype=jnp.float32, **cfg_kw))
+        tr = Trainer(model, optax.sgd(1e-2), cross_entropy_loss,
+                     mesh=create_mesh(**axes), strategy="dp")
+        return [float(tr.train_step(batch)["loss"]) for _ in range(3)]
+
+    seq = run(dict(), dict())
+    f1b = run(dict(pipeline_stages=4, pipeline_microbatches=4,
+                   pp_schedule="1f1b"), dict(data=2, pipe=4))
+    np.testing.assert_allclose(f1b, seq, atol=2e-5)
 
 
 def test_pipeline_validations():
