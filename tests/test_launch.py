@@ -192,3 +192,28 @@ def test_torchrun_style_elastic_restart(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "restart 1/1" in proc.stderr
+
+
+def test_stale_ranks_clocks(tmp_path):
+    """Unit check of the agent's two staleness clocks: a rank WITH a beat
+    file is judged by `timeout` from its mtime; a rank with NO file (still
+    importing / compiling) gets the more generous `grace` from spawn."""
+    import os
+
+    from pytorchdistributed_tpu.runtime.heartbeat import stale_ranks
+
+    spawn = 1000.0
+    (tmp_path / "rank0").touch()
+    os.utime(tmp_path / "rank0", times=(spawn + 5, spawn + 5))
+    # rank1 never beat (no file)
+    kw = dict(timeout=2.0, grace=30.0, baseline=spawn)
+    # t=6: rank0 fresh (beat at +5), rank1 inside grace
+    assert stale_ranks(tmp_path, 2, now=spawn + 6, **kw) == []
+    # t=8: rank0 stale (3s > timeout), rank1 still inside grace
+    assert stale_ranks(tmp_path, 2, now=spawn + 8, **kw) == [0]
+    # t=31: rank1 exceeded grace too
+    assert stale_ranks(tmp_path, 2, now=spawn + 31, **kw) == [0, 1]
+    # a fresh incarnation's baseline resets both clocks (stale old mtimes
+    # are ignored via max(mtime, baseline))
+    assert stale_ranks(tmp_path, 2, timeout=2.0, grace=30.0,
+                       now=spawn + 100, baseline=spawn + 99) == []
