@@ -431,10 +431,51 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "scaling": bench_scaling}
 
 
+# benches that force the CPU sim in their own bodies and need no
+# accelerator probe — extend alongside BENCHES
+CPU_SIM_BENCHES = {"sweep", "scaling"}
+
+
+def _probe_device(timeout_s: float = 120.0) -> None:
+    """Fail fast if the accelerator is unreachable. The axon TPU tunnel can
+    wedge so hard that even `jax.devices()` blocks forever INSIDE native
+    code (observed r3: hours of downtime, unkillable from Python) — probe
+    in a subprocess so a dead tunnel yields a clean error instead of a
+    silently hung bench run. The child runs in its own session and is
+    never waited on unboundedly: a D-state child that ignores SIGKILL (or
+    forked grandchildren holding pipes open) must not re-hang the parent."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].device_kind)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        code = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        print(f"bench: accelerator unreachable (device probe hung "
+              f"{timeout_s:.0f}s — tunnel wedged?)", file=sys.stderr)
+        raise SystemExit(2)
+    if code != 0:
+        print(f"bench: device probe failed:\n{proc.stderr.read()}",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--bench", choices=sorted(BENCHES), default="gpt2")
     args = parser.parse_args()
+    if args.bench not in CPU_SIM_BENCHES:
+        _probe_device()
     result = BENCHES[args.bench]()
     vs = _vs_baseline(result["metric"], result["value"])
     if vs is not None:  # metrics without a committed baseline omit the ratio
