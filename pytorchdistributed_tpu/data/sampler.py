@@ -82,6 +82,16 @@ class ShardedSampler:
         start = self.rank * self.num_samples
         return self._global_indices()[start : start + self.num_samples]
 
+    def valid_mask(self) -> np.ndarray:
+        """True where ``local_indices()[i]`` is a real sample, False where it
+        is wrap-around padding (with drop_last=False the global index list is
+        padded past ``dataset_size`` by repeating the permutation head, and
+        the pad tail lands in the last replica's shard). `Trainer.evaluate`
+        turns this into per-sample weights so padded duplicates don't skew
+        eval means (ADVICE r2)."""
+        start = self.rank * self.num_samples
+        return np.arange(start, start + self.num_samples) < self.dataset_size
+
     def __iter__(self):
         return iter(self.local_indices().tolist())
 

@@ -21,6 +21,53 @@ import jax.numpy as jnp
 import optax
 
 
+def _sample_weight(batch):
+    """Optional per-sample weight [batch] in fp32, else None. Injected by
+    `Trainer.evaluate` to zero out the wrap-around padding samples a
+    multi-replica `ShardedSampler` appends with drop_last=False (ADVICE r2:
+    those duplicates used to be counted in eval means)."""
+    w = batch.get("sample_weight")
+    return None if w is None else w.astype(jnp.float32)
+
+
+def _weighted_scalar(values, w):
+    """Mean of per-sample ``values`` [batch], weighted by ``w`` (or plain
+    mean when no weights ride the batch)."""
+    if w is None:
+        return values.mean()
+    return (values.astype(jnp.float32) * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def _token_loss_reduce(ce, batch):
+    """Reduce per-token CE [batch, seq] to the scalar loss, combining the
+    MLM ``loss_mask`` with the eval-time ``sample_weight``. Returns
+    ``(loss, extras)`` where extras carries ``_mask_count`` (the number of
+    tokens the loss was normalized over) whenever any masking applied —
+    the Trainer's gradient-accumulation path weights per-micro-batch grads
+    by it so accum_steps>1 reproduces the full-batch masked mean EXACTLY
+    (the same global-normalization trick PipelineParts.targets_of uses on
+    the 1F1B path); underscore keys never reach logs or eval totals."""
+    mask = batch.get("loss_mask")
+    w = _sample_weight(batch)
+    if mask is None and w is None:
+        return ce.mean(), {}
+    m = jnp.ones(ce.shape, jnp.float32)
+    if mask is not None:
+        m = m * mask.astype(jnp.float32)
+    if w is not None:
+        m = m * w[:, None]
+    count = m.sum()
+    # where, not bare multiply: a non-finite CE at a masked-out position
+    # (bf16 logit overflow on padding garbage) must be dropped, and
+    # inf * 0.0 would be NaN
+    ce = jnp.where(m > 0, ce, 0.0)
+    loss = (ce * m).sum() / jnp.maximum(count, 1.0)
+    # _mask_count carries the UNclamped sum: a fully-masked-out micro-batch
+    # contributes zero weight to the accumulated grads, keeping the global
+    # normalization exact
+    return loss, {"_mask_count": count}
+
+
 def _stochastic_kwargs(target, rng):
     """(kwargs for model.apply) selecting train-mode behavior when ``rng``
     is set: only for methods that take ``deterministic``. That flag now
@@ -39,7 +86,9 @@ def _stochastic_kwargs(target, rng):
 
 def mse_loss(model, params, batch, rng=None):
     pred = model.apply(params, batch["x"])
-    loss = jnp.mean((pred - batch["y"]) ** 2)
+    sq = (pred - batch["y"]) ** 2
+    per_sample = sq.reshape(sq.shape[0], -1).mean(-1)
+    loss = _weighted_scalar(per_sample, _sample_weight(batch))
     return loss, {"loss": loss}
 
 
@@ -57,10 +106,11 @@ def cross_entropy_loss(model, params, batch, rng=None):
                                    **kwargs)
     else:
         logits = model.apply(params, batch["image"], **kwargs)
-    loss = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), batch["label"]
-    ).mean()
-    acc = (logits.argmax(-1) == batch["label"]).mean()
+    w = _sample_weight(batch)
+    loss = _weighted_scalar(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), batch["label"]), w)
+    acc = _weighted_scalar(logits.argmax(-1) == batch["label"], w)
     metrics = {"loss": loss, "accuracy": acc}
     if mutable:
         metrics["_collections"] = mods
@@ -74,13 +124,8 @@ def token_cross_entropy_loss(model, params, batch, rng=None):
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"]
     )
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        ce = jnp.where(mask, ce, 0.0)
-        loss = ce.sum() / jnp.maximum(mask.sum(), 1)
-    else:
-        loss = ce.mean()
-    return loss, {"loss": loss}
+    loss, extras = _token_loss_reduce(ce, batch)
+    return loss, {"loss": loss, **extras}
 
 
 def fused_token_cross_entropy_loss(model, params, batch, rng=None):
@@ -95,13 +140,8 @@ def fused_token_cross_entropy_loss(model, params, batch, rng=None):
                      method=type(model).loss_per_position,
                      **_stochastic_kwargs(type(model).loss_per_position,
                                           rng))
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        ce = jnp.where(mask, ce, 0.0)
-        loss = ce.sum() / jnp.maximum(mask.sum(), 1)
-    else:
-        loss = ce.mean()
-    return loss, {"loss": loss}
+    loss, extras = _token_loss_reduce(ce, batch)
+    return loss, {"loss": loss, **extras}
 
 
 MOE_AUX_WEIGHT = 0.01  # Switch Transformer's load-balance coefficient
@@ -119,13 +159,9 @@ def moe_token_cross_entropy_loss(model, params, batch, rng=None):
                                **_stochastic_kwargs(type(model).__call__, rng))
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"])
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        ce = jnp.where(mask, ce, 0.0)
-        ce = ce.sum() / jnp.maximum(mask.sum(), 1)
-    else:
-        ce = ce.mean()
+    ce, extras = _token_loss_reduce(ce, batch)
     sown = jax.tree.leaves(mods.get("losses", {}))
     aux = (sum(jnp.mean(v) for v in sown) / max(len(sown), 1)) if sown else 0.0
     loss = ce + MOE_AUX_WEIGHT * aux
-    return loss, {"loss": loss, "ce": ce, "moe_aux": jnp.float32(aux)}
+    return loss, {"loss": loss, "ce": ce, "moe_aux": jnp.float32(aux),
+                  **extras}

@@ -26,6 +26,7 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -273,14 +274,18 @@ class Trainer:
                 # Gradient accumulation: lax.scan over accum micro-batches
                 # INSIDE the jitted step (one compiled program, activations
                 # for one micro-batch alive at a time), fp32-accumulated
-                # grads averaged before the single optimizer update — the
-                # large-batch recipe when the full batch's activations
-                # exceed HBM. Exactness caveat (ADVICE r2): equal-weight
-                # averaging reproduces the full-batch loss exactly for
-                # unmasked CE/MSE; for masked losses (MLM loss_mask) each
-                # micro-batch normalizes by its own mask count, so accum>1
-                # approximates the global masked mean when mask counts vary
-                # across micro-batches.
+                # grads normalized once before the single optimizer update —
+                # the large-batch recipe when the full batch's activations
+                # exceed HBM. Masked losses (MLM loss_mask) are EXACT
+                # (closes ADVICE r2): each micro-batch reports its token
+                # count ("_mask_count"), its grads are weighted by it, and
+                # one global normalization follows — since each loss_i is
+                # ce_sum_i/count_i, Σ count_i·∇loss_i / Σ count_i =
+                # ∇(Σ ce_sum / Σ count), the full-batch masked mean. Same
+                # global-normalization trick as PipelineParts.targets_of on
+                # the 1F1B path. (The MoE aux term's grads ride the same
+                # weights — per-token weighting of a heuristic
+                # load-balance objective, a definition, not an error.)
                 def as_microbatches(leaf):
                     b = leaf.shape[0]
                     if b % accum:
@@ -291,24 +296,36 @@ class Trainer:
 
                 mbs = jax.tree.map(as_microbatches, batch)
 
-                def body(g_acc, mb_i):
+                def body(carry, mb_i):
+                    g_acc, c_acc = carry
                     mb, i = mb_i
                     (_, metrics), g = jax.value_and_grad(
                         compute_loss, has_aux=True
                     )(state.params, mb, jax.random.fold_in(rng, i))
+                    w = metrics.get("_mask_count")
+                    wi = jnp.float32(1.0) if w is None else w
                     g_acc = jax.tree.map(
-                        lambda a, b: a + b.astype(jnp.float32), g_acc, g)
-                    return g_acc, metrics
+                        lambda a, b: a + wi * b.astype(jnp.float32), g_acc, g)
+                    return (g_acc, c_acc + wi), metrics
 
                 g0 = jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-                grads, metrics = jax.lax.scan(
-                    body, g0, (mbs, jnp.arange(accum)))
-                grads = jax.tree.map(lambda g: g / accum, grads)
-                # scalar metrics mean over micro-batches; for "_collections"
-                # the mean of per-micro-batch EMA updates is itself one
-                # valid EMA step (each is m·base + (1-m)·stat_i)
-                metrics = jax.tree.map(lambda m: m.mean(0), metrics)
+                (grads, c_acc), metrics = jax.lax.scan(
+                    body, (g0, jnp.float32(0.0)), (mbs, jnp.arange(accum)))
+                c_acc = jnp.maximum(c_acc, 1.0)  # all-masked-out batch
+                grads = jax.tree.map(lambda g: g / c_acc, grads)
+                wts = metrics.pop("_mask_count", None)
+                if wts is None:
+                    # plain mean over micro-batches; for "_collections" the
+                    # mean of per-micro-batch EMA updates is itself one
+                    # valid EMA step (each is m·base + (1-m)·stat_i)
+                    metrics = jax.tree.map(lambda m: m.mean(0), metrics)
+                else:
+                    # token-count-weighted mean == the full-batch masked
+                    # mean (masked losses carry scalar metrics only, so
+                    # no "_collections" leaf rides this branch)
+                    metrics = jax.tree.map(
+                        lambda m: (m * wts).sum(0) / c_acc, metrics)
             # Mutable-collection updates (ResNet batch_stats EMA) ride the
             # metrics; they are STATE, not a scalar — fold into params after
             # the optimizer step (whose update for them is overwritten).
@@ -330,7 +347,10 @@ class Trainer:
             new_state = TrainState(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
-            metrics = {k: v.astype(jnp.float32) for k, v in metrics.items()}
+            # underscore keys are loss→trainer plumbing (_mask_count), not
+            # reportable metrics
+            metrics = {k: v.astype(jnp.float32) for k, v in metrics.items()
+                       if not k.startswith("_")}
             return new_state, metrics
 
         return jax.jit(
@@ -513,6 +533,13 @@ class Trainer:
         """Forward + loss with NO optimizer update (and no rng — dropout
         off). Jitted and cached on first use; params stay whatever
         train_step left them."""
+        return {k: v for k, v in self._eval_raw(batch).items()
+                if not k.startswith("_")}
+
+    def _eval_raw(self, batch) -> dict:
+        """eval_step including the underscore plumbing keys — evaluate()
+        reads "_mask_count" off this to weight masked-token batches by
+        token count."""
         if self.state is None:
             self.init(batch)
         if self._eval_fn is None:
@@ -524,7 +551,8 @@ class Trainer:
                 with nn.logical_axis_rules(self._rules):
                     _, metrics = self._loss_fn(self.model, cparams, cbatch,
                                                None)
-                return {k: v.astype(jnp.float32) for k, v in metrics.items()}
+                return {k: v.astype(jnp.float32)
+                        for k, v in metrics.items()}
 
             # Explicit in_shardings, same contract as the train step: a
             # mismatched-layout batch errors instead of silently re-laying
@@ -544,24 +572,62 @@ class Trainer:
         every sample is scored). The epoch is pinned to 0 so successive
         evaluate() calls score the SAME subset in the same order — val
         curves stay comparable across epochs; prefer shuffle=False val
-        loaders. Multi-replica caveat (ADVICE r2, same as torch's
-        DistributedSampler): with drop_last=False the sampler pads ranks
-        to equal count by repeating head indices, and those duplicates ARE
-        counted in the mean — an O(replicas/len) skew; use a
-        single-replica val loader when exactness matters. The reference
-        has no eval loop at all; this is the missing half of its
-        Trainer."""
+        loaders. Batch means are combined by the batch's true denominator —
+        masked-token losses report theirs ("_mask_count"), everything else
+        weights by sample count — so the result is the global mean over
+        real masked tokens / samples, independent of batch grouping.
+        Multi-replica (closes ADVICE r2): with drop_last=False the
+        sampler pads replicas to equal count by repeating head indices;
+        those padded duplicates are zero-weighted here — every batch
+        carries a ``sample_weight`` built from `ShardedSampler.valid_mask`,
+        the losses fold it into their means, and the totals weight by real
+        samples — so the multi-replica eval mean equals the single-replica
+        one exactly. (All-or-no batches carry the key, decided from the
+        sampler's global geometry, so every replica compiles the same
+        program.) Custom loss_fns: the exactness holds only if the loss
+        folds ``batch["sample_weight"]`` into its means the way the
+        built-in losses do (losses._sample_weight); one that ignores the
+        key still counts padded duplicates — use a single-replica val
+        loader there. The reference has no eval loop at all; this is the
+        missing half of its Trainer."""
         totals: dict = {}
-        count = 0
+        count = 0.0
         loader.set_epoch(0)
-        for batch in prefetch_to_device(iter(loader), self.batch_sharding):
-            n = self._batch_samples(batch)
-            metrics = self.eval_step(batch)
+        sampler = getattr(loader, "sampler", None)
+        padded = (sampler is not None and getattr(sampler, "total_size", 0)
+                  > getattr(sampler, "dataset_size", 0))
+
+        def batches():
+            if not padded:
+                yield from loader
+                return
+            valid = sampler.valid_mask()
+            bs = loader.batch_size
+            for b, batch in enumerate(loader):
+                n_local = self._batch_samples(batch)
+                w = valid[b * bs: b * bs + n_local].astype(np.float32)
+                yield {**batch, "sample_weight": w}
+
+        for batch in prefetch_to_device(batches(), self.batch_sharding):
+            metrics = self._eval_raw(batch)
+            # batch weight, most-exact first: masked-token losses report
+            # their token count ("_mask_count" — weighting batch means by
+            # it reproduces the global masked-token mean exactly across any
+            # batch/replica grouping); else the real-sample count (the
+            # pad-excluding weight sum, device-lazy) / the global batch size
+            wtok = metrics.pop("_mask_count", None)
+            if wtok is not None:
+                n = wtok
+            elif padded:
+                n = batch["sample_weight"].astype(jnp.float32).sum()
+            else:
+                n = self._batch_samples(batch)
             for k, v in metrics.items():
                 # device-side accumulation: a per-batch float() here would
                 # block the host each step and defeat the prefetch overlap
                 totals[k] = totals.get(k, 0.0) + v * n
             count += n
+        count = float(count)
         if count == 0:
             return {}
         out = {k: float(v) / count for k, v in totals.items()}

@@ -30,6 +30,16 @@ class TestShardedSampler:
         union = set().union(*(set(s) for s in samplers))
         assert union == set(range(10))  # every sample appears
 
+    def test_valid_mask_marks_wraparound_padding(self):
+        # 10 over 4 replicas → 3 each, 2 pads; pads land at the global tail
+        # (the last rank), and valid_mask flags exactly those positions.
+        samplers = [ShardedSampler(10, 4, r) for r in range(4)]
+        masks = [s.valid_mask() for s in samplers]
+        assert all(m.all() for m in masks[:3])
+        np.testing.assert_array_equal(masks[3], [True, False, False])
+        # drop_last never pads
+        assert ShardedSampler(10, 4, 1, drop_last=True).valid_mask().all()
+
     def test_drop_last_truncates(self):
         s = ShardedSampler(10, 4, 0, drop_last=True)
         assert len(s) == 2

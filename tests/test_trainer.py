@@ -212,6 +212,85 @@ def test_accum_steps_matches_large_batch():
     np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5, atol=1e-6)
 
 
+def test_accum_exact_for_masked_loss():
+    """Masked-loss accumulation is EXACT (VERDICT r3 #5): each micro-batch's
+    grads are weighted by its token count ("_mask_count") and normalized
+    once, so accum_steps=4 with RAGGED loss masks reproduces the
+    accum_steps=1 full-batch masked mean — the regime where the old
+    equal-weight averaging was only approximate."""
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import token_cross_entropy_loss
+
+    rng = np.random.default_rng(7)
+    # per-sample keep probabilities ramp 5%→95%, so the four micro-batch
+    # slices carry very different mask counts
+    mask = rng.random((32, 16)) < np.linspace(0.05, 0.95, 32)[:, None]
+    batch = {
+        "tokens": rng.integers(0, 128, (32, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (32, 16)).astype(np.int32),
+        "loss_mask": mask,
+    }
+    losses = {}
+    for accum in (1, 4):
+        model = GPT2(gpt2_config("test", dtype=np.float32))
+        tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(), strategy="dp", accum_steps=accum)
+        losses[accum] = [float(tr.train_step(batch)["loss"])
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5, atol=1e-6)
+
+
+def test_multi_replica_eval_ignores_padding():
+    """2-replica eval over a ragged val set equals the single-replica mean
+    exactly (VERDICT r3 #6): evaluate() zero-weights the wrap-around pad
+    duplicates via ShardedSampler.valid_mask, so combining the per-rank
+    means by REAL sample counts reproduces the global mean."""
+    ds = SyntheticRegressionDataset(size=37, seed=8)
+    mesh = local_mesh(1)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=mesh, log_every=10**9)
+    single = DataLoader(ds, batch_size=8, num_replicas=1, rank=0,
+                        shuffle=False, drop_last=False)
+    tr.init(next(iter(single)))
+    want = tr.evaluate(single)["loss"]
+    parts = []
+    for rank in (0, 1):
+        loader = DataLoader(ds, batch_size=8, num_replicas=2, rank=rank,
+                            shuffle=False, drop_last=False)
+        got = tr.evaluate(loader)["loss"]
+        nreal = int(loader.sampler.valid_mask().sum())
+        parts.append((got, nreal))
+    # 37 over 2 replicas: 19 each, one wrap-around pad on the last rank
+    assert [n for _, n in parts] == [19, 18]
+    combined = (sum(v * n for v, n in parts)
+                / sum(n for _, n in parts))
+    np.testing.assert_allclose(combined, want, rtol=1e-6)
+
+
+def test_masked_eval_independent_of_batch_grouping():
+    """For masked-token losses, evaluate() weights each batch mean by its
+    token count ("_mask_count"), so the result is the global masked-token
+    mean — identical across batch sizes, which sample-count weighting of
+    ragged masks cannot deliver."""
+    from pytorchdistributed_tpu.data import MLMDataset, SyntheticTokenDataset
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import token_cross_entropy_loss
+
+    ds = MLMDataset(SyntheticTokenDataset(size=24, seq_len=16, vocab_size=128,
+                                          seed=9), vocab_size=128, seed=9)
+    tr = Trainer(GPT2(gpt2_config("test", dtype=np.float32)),
+                 optax.sgd(1e-2), token_cross_entropy_loss,
+                 mesh=local_mesh(1), log_every=10**9)
+    results = []
+    for bs in (24, 8, 4):
+        loader = DataLoader(ds, batch_size=bs, num_replicas=1, rank=0,
+                            shuffle=False, drop_last=False)
+        if not results:
+            tr.init(next(iter(loader)))
+        results.append(tr.evaluate(loader)["loss"])
+    np.testing.assert_allclose(results[1:], results[0], rtol=1e-6)
+
+
 def test_accum_rejects_1f1b():
     """accum_steps must not be silently ignored on the fused-1F1B path."""
     from pytorchdistributed_tpu.models import GPT2, gpt2_config
