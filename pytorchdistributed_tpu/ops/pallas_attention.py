@@ -41,16 +41,37 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
                 block_q: int, block_k: int, causal: bool, scale: float,
-                num_k_blocks: int, seq_len: int):
+                num_k_blocks: int, seq_len: int, carry: bool = False):
+    """Online-softmax forward, one definition for both attention paths.
+
+    ``carry`` is static and selects the ref layout at trace time (no HBM
+    zero-read is ever emitted for the carry=False flagship path):
+      * False (single-chip flash): refs = (o_ref, lse_ref, acc_s, m_s, l_s)
+        — (m, l, acc) init to zeros/-inf in VMEM and the last k-block
+        normalizes into (o, lse);
+      * True (one ring-attention hop, ops/ring_attention.py): refs =
+        (m_in, l_in, acc_in, m_out, l_out, acc_out, acc_s, m_s, l_s) — the
+        statistics enter and leave through HBM so they survive across ring
+        steps, and normalization happens once after the last hop."""
+    if carry:
+        (m_in, l_in, acc_in, m_out, l_out, acc_out,
+         acc_s, m_s, l_s) = refs
+    else:
+        o_ref, lse_ref, acc_s, m_s, l_s = refs
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+        if carry:
+            m_s[...] = m_in[0]
+            l_s[...] = l_in[0]
+            acc_s[...] = acc_in[0]
+        else:
+            acc_s[...] = jnp.zeros_like(acc_s)
+            m_s[...] = jnp.full_like(m_s, _NEG_INF)
+            l_s[...] = jnp.zeros_like(l_s)
 
     qi = pl.program_id(1)
     q_start = qi * block_q
@@ -80,26 +101,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             q_pos = q_start + lax.broadcasted_iota(jnp.int32, logits.shape, 0)
             valid = valid & (q_pos >= k_pos)
         logits = jnp.where(valid, logits, _NEG_INF)
-        m_prev = m_ref[...]
+        m_prev = m_s[...]
         blk_max = jnp.max(logits, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, blk_max)
         corr = jnp.exp(m_prev - m_new)
         p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)  # [bq, bk]
-        l_ref[...] = l_ref[...] * corr + jnp.sum(p, -1, keepdims=True)
-        m_ref[...] = m_new
-        v = v_ref[0]                                       # [bk, d]
+        l_s[...] = l_s[...] * corr + jnp.sum(p, -1, keepdims=True)
+        m_s[...] = m_new
         # zero the padded V tail: p is 0 there, but 0·garbage(NaN) = NaN
-        v_pos = k_start + lax.broadcasted_iota(jnp.int32, v.shape, 0)
-        v = jnp.where(v_pos < seq_len, v, jnp.zeros_like(v))
-        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        v = _zero_pad_rows(v_ref[0], k_start, seq_len)     # [bk, d]
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[...] + jnp.log(l)      # [bq, 1]
+        if carry:
+            m_out[0] = m_s[...]
+            l_out[0] = l_s[...]
+            acc_out[0] = acc_s[...]
+        else:
+            l = jnp.maximum(l_s[...], 1e-30)
+            o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+            lse_ref[0] = m_s[...] + jnp.log(l)    # [bq, 1]
 
 
 def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
@@ -188,21 +212,36 @@ def _recompute_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk, *,
     return p, ds
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                     block_q: int, block_k: int, causal: bool, scale: float,
-                    num_q_blocks: int, seq_len: int, group: int):
+                    num_q_blocks: int, seq_len: int, group: int,
+                    carry: bool = False):
     # grid (B·H_kv, k_blocks, group, q_blocks): for one (kv-head, K block)
     # the group's q-heads and their q blocks run CONSECUTIVELY, so the
     # VMEM accumulator legally carries dK/dV across all of them — the
     # grouped-query reduction happens inside the kernel instead of an XLA
     # sum over a 4x-repeated dk tensor.
+    #
+    # ``carry`` (static, see _fwd_kernel): False → refs = (dk_ref, dv_ref,
+    # dk_acc, dv_acc), zero-init specialized at trace time (the flagship
+    # path never reads zeros from HBM); True → refs = (dk_in, dv_in,
+    # dk_ref, dv_ref, dk_acc, dv_acc), the ring's co-travelling dK/dV
+    # accumulators entering/leaving through HBM each hop (group is 1
+    # there — the ring path is not GQA-folded).
+    if carry:
+        dk_in, dv_in, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     gi, qi = pl.program_id(2), pl.program_id(3)
 
     @pl.when((qi == 0) & (gi == 0))
     def _init():
-        dk_acc[...] = jnp.zeros_like(dk_acc)
-        dv_acc[...] = jnp.zeros_like(dv_acc)
+        if carry:
+            dk_acc[...] = dk_in[0]
+            dv_acc[...] = dv_in[0]
+        else:
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
 
     ki = pl.program_id(1)
     q_start = qi * block_q
@@ -234,15 +273,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                    block_q: int, block_k: int, causal: bool, scale: float,
-                   num_k_blocks: int, seq_len: int):
+                   num_k_blocks: int, seq_len: int, carry: bool = False):
+    # ``carry`` (static, see _fwd_kernel): False → refs = (dq_ref, dq_acc),
+    # zero-init at trace time; True → refs = (dq_in, dq_ref, dq_acc), the
+    # ring hop's dQ accumulator entering through HBM.
+    if carry:
+        dq_in, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
-        dq_acc[...] = jnp.zeros_like(dq_acc)
+        dq_acc[...] = dq_in[0] if carry else jnp.zeros_like(dq_acc)
 
     q_start = qi * block_q
     k_start = ki * block_k

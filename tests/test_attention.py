@@ -110,7 +110,7 @@ def test_sequence_parallel_matches_dense(qkv, causal, impl):
 def test_ring_small_blocks_padded_tail(qkv, causal):
     """Multi-block ring kernels with a padded tail: block 12 against
     S_local=16 gives nq=nk=2 with a 4-row pad, exercising the seq_len
-    masks and _zero_pad_rows guards in all three ring kernels (the default
+    masks and _zero_pad_rows guards in all three carry=True kernels (the default
     block size min()-clamps to S_local, so the other ring tests never
     leave the single-block case)."""
     q, k, v = qkv
