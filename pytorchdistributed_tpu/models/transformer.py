@@ -420,16 +420,21 @@ class MlpBlock(nn.Module):
 
 
 def _layer_norm(cfg, name):
+    """Fused-backward norms (ops/norms.py): fp32 normalization math like
+    the flax originals (same param trees, so checkpoints are unchanged),
+    but the custom_vjp keeps bf16 residuals + row stats instead of AD's
+    fp32 intermediates — the r3 profile's ~64 ms/step of norm-backward
+    reduce fusions on Llama-1B (BASELINE.md)."""
+    from pytorchdistributed_tpu.ops.norms import FusedLayerNorm, FusedRMSNorm
+
     if cfg.norm == "rmsnorm":
-        return nn.RMSNorm(
-            dtype=jnp.float32,
+        return FusedRMSNorm(
             param_dtype=cfg.param_dtype,
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones_init(), (Logical.EMBED,)),
             name=name,
         )
-    return nn.LayerNorm(
-        dtype=jnp.float32,  # normalize in fp32 regardless of compute dtype
+    return FusedLayerNorm(
         param_dtype=cfg.param_dtype,
         scale_init=nn.with_logical_partitioning(
             nn.initializers.ones_init(), (Logical.EMBED,)),

@@ -1,0 +1,144 @@
+"""Fused LayerNorm / RMSNorm with hand-written backward (custom_vjp).
+
+Why not flax's nn.LayerNorm/nn.RMSNorm + AD: with fp32 normalization math
+over bf16 activations (the TPU mixed-precision contract), AD saves the
+UPCAST fp32 [batch, seq, embed] intermediates as residuals and re-reads
+them across several backward fusions — the r3 Llama-1B profile attributed
+~64 ms/step to norm-backward reduce fusions (BASELINE.md). Here the
+residuals are the bf16 input plus the per-row statistics ([..., 1] fp32 —
+negligible), the upcast is re-done inside the one backward fusion (free:
+it fuses into the reduce), and the whole dx expression is a single
+elementwise+row-reduce program XLA can emit as one pass:
+
+    rmsnorm:   dx = rsigma · (g − xhat · mean(g ∘ xhat)),  g = dy·scale
+    layernorm: dx = rsigma · (g − mean(g) − xhat · mean(g ∘ xhat))
+
+with xhat recomputed from (x, stats). Parameter grads reduce over the row
+axes in the same pass: dscale = Σ dy ∘ xhat, dbias = Σ dy.
+
+The flax Modules below are drop-in replacements for nn.RMSNorm /
+nn.LayerNorm (same param names/shapes/partitioning, fp32 output), so
+checkpoints and sharding rules are unchanged. Equivalence vs the flax
+originals is asserted in tests/test_norms.py.
+
+Reference parity note: the reference never defines a norm (its models come
+from torchvision/PyTorch, SURVEY.md §2a); this is hot-path kernel work the
+TPU build owns outright.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rmsnorm(x, scale, eps: float):
+    """y = x / sqrt(mean(x², -1) + eps) · scale, computed in fp32,
+    returned fp32 (caller casts to its compute dtype)."""
+    y, _ = _rms_fwd_math(x, scale, eps)
+    return y
+
+
+def _rms_fwd_math(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    rsigma = jax.lax.rsqrt(var + eps)
+    y = x32 * rsigma * scale.astype(jnp.float32)
+    return y, rsigma
+
+
+def _rms_fwd(x, scale, eps):
+    y, rsigma = _rms_fwd_math(x, scale, eps)
+    return y, (x, rsigma, scale)
+
+
+def _rms_bwd(eps, res, dy):
+    x, rsigma, scale = res
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = x32 * rsigma
+    g = dy32 * scale.astype(jnp.float32)
+    c = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx = (rsigma * (g - xhat * c)).astype(x.dtype)
+    dscale = jnp.sum(dy32 * xhat,
+                     axis=tuple(range(x.ndim - 1))).astype(scale.dtype)
+    return dx, dscale
+
+
+rmsnorm.defvjp(_rms_fwd, _rms_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layernorm(x, scale, bias, eps: float):
+    """y = (x − mean(x)) / sqrt(var(x) + eps) · scale + bias in fp32."""
+    y, _, _ = _ln_fwd_math(x, scale, bias, eps)
+    return y
+
+
+def _ln_fwd_math(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    xc = x32 - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rsigma = jax.lax.rsqrt(var + eps)
+    y = xc * rsigma * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y, mu, rsigma
+
+
+def _ln_fwd(x, scale, bias, eps):
+    y, mu, rsigma = _ln_fwd_math(x, scale, bias, eps)
+    return y, (x, mu, rsigma, scale)
+
+
+def _ln_bwd(eps, res, dy):
+    x, mu, rsigma, scale = res
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mu) * rsigma
+    g = dy32 * scale.astype(jnp.float32)
+    c1 = jnp.mean(g, axis=-1, keepdims=True)
+    c2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx = (rsigma * (g - c1 - xhat * c2)).astype(x.dtype)
+    row_axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(dy32 * xhat, axis=row_axes).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=row_axes).astype(scale.dtype)
+    return dx, dscale, dbias
+
+
+layernorm.defvjp(_ln_fwd, _ln_bwd)
+
+
+class FusedRMSNorm(nn.Module):
+    """nn.RMSNorm drop-in (param "scale", fp32 math/output) over the fused
+    custom_vjp above."""
+
+    epsilon: float = 1e-6
+    param_dtype: jnp.dtype = jnp.float32
+    scale_init: nn.initializers.Initializer = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", self.scale_init, (x.shape[-1],),
+                           self.param_dtype)
+        return rmsnorm(x, scale, self.epsilon)
+
+
+class FusedLayerNorm(nn.Module):
+    """nn.LayerNorm drop-in (params "scale"/"bias", fp32 math/output)."""
+
+    epsilon: float = 1e-6
+    param_dtype: jnp.dtype = jnp.float32
+    scale_init: nn.initializers.Initializer = nn.initializers.ones_init()
+    bias_init: nn.initializers.Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", self.scale_init, (x.shape[-1],),
+                           self.param_dtype)
+        bias = self.param("bias", self.bias_init, (x.shape[-1],),
+                          self.param_dtype)
+        return layernorm(x, scale, bias, self.epsilon)

@@ -1,0 +1,71 @@
+"""Fused-norm equivalence: the custom_vjp norms (ops/norms.py) must match
+flax's nn.RMSNorm / nn.LayerNorm — values AND gradients — since every model
+routes through them (models/transformer.py _layer_norm)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu.ops.norms import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    layernorm,
+    rmsnorm,
+)
+
+
+def _grads(fn, *args):
+    return jax.grad(lambda *a: jnp.sum(fn(*a) ** 2), argnums=range(len(args))
+                    )(*args)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rmsnorm_matches_flax(dtype):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)) * 3, dtype)
+    scale = jnp.asarray(rng.standard_normal(32) * 0.5 + 1.0, jnp.float32)
+    ref_mod = nn.RMSNorm(dtype=jnp.float32, use_scale=True)
+    ref = lambda x, s: ref_mod.apply({"params": {"scale": s}}, x)
+    got = lambda x, s: rmsnorm(x, s, 1e-6)
+    # forward
+    np.testing.assert_allclose(got(x, scale), ref(x, scale),
+                               rtol=1e-5, atol=1e-5)
+    # grads — bf16 inputs quantize dx to bf16, hence the looser tolerance
+    tol = dict(rtol=1e-4, atol=1e-4) if dtype == jnp.float32 else \
+        dict(rtol=2e-2, atol=2e-2)
+    for a, b in zip(_grads(got, x, scale), _grads(ref, x, scale)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **tol)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layernorm_matches_flax(dtype):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)) * 3 + 1, dtype)
+    scale = jnp.asarray(rng.standard_normal(32) * 0.5 + 1.0, jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(32) * 0.1, jnp.float32)
+    ref_mod = nn.LayerNorm(dtype=jnp.float32)
+    ref = lambda x, s, b: ref_mod.apply({"params": {"scale": s, "bias": b}}, x)
+    got = lambda x, s, b: layernorm(x, s, b, 1e-6)
+    np.testing.assert_allclose(got(x, scale, bias), ref(x, scale, bias),
+                               rtol=1e-5, atol=1e-5)
+    tol = dict(rtol=1e-4, atol=1e-4) if dtype == jnp.float32 else \
+        dict(rtol=2e-2, atol=2e-2)
+    for a, b in zip(_grads(got, x, scale, bias),
+                    _grads(ref, x, scale, bias)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **tol)
+
+
+def test_fused_modules_param_trees_match_flax():
+    """Checkpoint compatibility: same param names/shapes as the flax
+    modules they replace."""
+    x = jnp.ones((2, 8))
+    fused = FusedRMSNorm().init(jax.random.key(0), x)
+    flax_ = nn.RMSNorm().init(jax.random.key(0), x)
+    assert jax.tree.structure(fused) == jax.tree.structure(flax_)
+    fused = FusedLayerNorm().init(jax.random.key(0), x)
+    flax_ = nn.LayerNorm().init(jax.random.key(0), x)
+    assert jax.tree.structure(fused) == jax.tree.structure(flax_)
