@@ -332,8 +332,57 @@ def bench_sweep() -> dict:
     best = min(results, key=results.get)
     print(f"sweep step seconds: {results} (best schedule,microbatches={best})",
           file=sys.stderr, flush=True)
+    try:
+        _render_sweep_plot(results, "split_size_tradeoff.png")
+        print("sweep plot written to split_size_tradeoff.png",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # the number is the bench; the plot is a bonus
+        print(f"sweep plot skipped: {e}", file=sys.stderr, flush=True)
     return {"metric": "pp_sweep_best_tokens_per_s",
             "value": round(32 * 128 / results[best], 1), "unit": "tokens/s"}
+
+
+def _render_sweep_plot(results: dict, path: str) -> None:
+    """The reference's `split_size_tradeoff.png` analog
+    (03_model_parallel.ipynb:586-623, PNG at 03 模型并行/): step time vs
+    micro-batch count, one line per schedule. Micro-batch count is our
+    tunable where the reference sweeps `split_size` — same tradeoff (more
+    splits shrink the bubble, too many drown in per-split overhead)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    colors = {"gpipe": "#2a78d6", "1f1b": "#eb6834"}
+    fig, ax = plt.subplots(figsize=(7, 4.2), dpi=120)
+    fig.patch.set_facecolor("#fcfcfb")
+    ax.set_facecolor("#fcfcfb")
+    for sched in ("gpipe", "1f1b"):
+        pts = sorted((m, t) for (s, m), t in results.items() if s == sched)
+        xs = [m for m, _ in pts]
+        ys = [t * 1e3 for _, t in pts]
+        ax.plot(xs, ys, marker="o", markersize=6, linewidth=2,
+                color=colors[sched], label=sched)
+        ax.annotate(sched, (xs[-1], ys[-1]), textcoords="offset points",
+                    xytext=(8, 0), color="#52514e", fontsize=9,
+                    va="center")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks([m for (s, m) in results if s == "gpipe"])
+    ax.get_xaxis().set_major_formatter(plt.ScalarFormatter())
+    ax.set_xlabel("pipeline micro-batches (reference: split_size)",
+                  color="#0b0b0b")
+    ax.set_ylabel("step time (ms)", color="#0b0b0b")
+    ax.set_title("Pipeline split-size tradeoff (2-stage GPT-2, 2-dev sim)",
+                 color="#0b0b0b", fontsize=11)
+    ax.grid(True, which="major", color="#e8e7e4", linewidth=0.8)
+    ax.tick_params(colors="#52514e")
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color("#c3c2b7")
+    ax.legend(frameon=False, labelcolor="#0b0b0b")
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)
 
 
 _SCALING_PER_PROC_BATCH = 8
@@ -424,16 +473,102 @@ def bench_scaling() -> dict:
             "efficiency": {str(k): v for k, v in eff.items()}}
 
 
+def _scaling_sim_worker(n: int) -> None:
+    """One weak-scaling point IN PROCESS: n sim devices (XLA_FLAGS set by
+    the parent), one pjit'd DDP step over a data=n mesh with an n-scaled
+    global batch. Prints JSON {sec_per_step: [3 windows]} to stdout."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == n, (n, jax.devices())
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    model = GPT2(gpt2_config("test", num_layers=4, dtype=jnp.float32))
+    tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                 mesh=create_mesh(data=n), strategy="dp", log_every=10**9,
+                 watchdog=False)
+    rng = np.random.default_rng(0)
+    b = _SCALING_PER_PROC_BATCH * n  # weak scaling: fixed per-device work
+    batch = {
+        "tokens": rng.integers(0, 128, (b, 64)).astype(np.int32),
+        "targets": rng.integers(0, 128, (b, 64)).astype(np.int32),
+    }
+    tr.init(batch)
+    metrics = None
+    for _ in range(2):
+        metrics = tr.train_step(batch)
+    float(metrics["loss"])
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            metrics = tr.train_step(batch)
+        float(metrics["loss"])  # sync the async dispatch queue
+        windows.append((time.perf_counter() - t0) / 8)
+    print(json.dumps({"sec_per_step": windows}))
+
+
+def bench_scaling_sim() -> dict:
+    """In-process weak scaling (VERDICT r3 #8): 1/2/4/8 SIM devices in one
+    process each (a fresh subprocess per point so the device count can
+    differ), same per-device workload, no jax.distributed / OS-process
+    contention in the measurement. On a serialized CPU host, n devices run
+    n× the compute back-to-back, so perfect sharding gives step-time
+    inflation t_n/(n·t_1) ≈ 1 regardless of core count — anything above 1
+    is per-step overhead the sharding added (collectives, scheduling,
+    layout changes). That makes eff = n·t_1/t_n a STABLE tripwire for
+    collective-overhead regressions where the real-process harness
+    (--bench scaling) drowns in core contention on a 1-core rig; the pod
+    run still uses the real-process harness."""
+    import os
+    import subprocess
+    import sys
+
+    sec, std = {}, {}
+    for n in (1, 2, 4, 8):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--scaling-sim-worker", str(n)],
+            env=env, capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:  # surface the child's reason, fail fast
+            print(f"scaling_sim worker n={n} failed:\n{proc.stderr}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        windows = json.loads(proc.stdout.strip().splitlines()[-1])[
+            "sec_per_step"]
+        sec[n] = float(np.mean(windows))
+        std[n] = float(np.std(windows))
+    eff = {n: round(n * sec[1] / sec[n], 4) for n in sec}
+    print(f"sim weak scaling: sec/step {sec} (std {std}) efficiency {eff}",
+          file=sys.stderr, flush=True)
+    return {"metric": "sim_weak_scaling_eff_8dev", "value": eff[8],
+            "unit": "efficiency",
+            "sec_per_step": {str(k): round(v, 5) for k, v in sec.items()},
+            "sec_std": {str(k): round(v, 5) for k, v in std.items()},
+            "efficiency": {str(k): v for k, v in eff.items()}}
+
+
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "gpt2medium": functools.partial(bench_gpt2, "medium"),
            "resnet50": bench_resnet50, "generate": bench_generate,
            "mlp": bench_mlp, "sweep": bench_sweep,
-           "scaling": bench_scaling}
+           "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 
 
 # benches that force the CPU sim in their own bodies and need no
 # accelerator probe — extend alongside BENCHES
-CPU_SIM_BENCHES = {"sweep", "scaling"}
+CPU_SIM_BENCHES = {"sweep", "scaling", "scaling_sim"}
 
 
 def _probe_device(timeout_s: float = 120.0) -> None:
@@ -473,7 +608,12 @@ def _probe_device(timeout_s: float = 120.0) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--bench", choices=sorted(BENCHES), default="gpt2")
+    parser.add_argument("--scaling-sim-worker", type=int, default=None,
+                        help=argparse.SUPPRESS)  # bench_scaling_sim child
     args = parser.parse_args()
+    if args.scaling_sim_worker is not None:
+        _scaling_sim_worker(args.scaling_sim_worker)
+        return
     if args.bench not in CPU_SIM_BENCHES:
         _probe_device()
     result = BENCHES[args.bench]()
