@@ -16,6 +16,23 @@ exits, so the agent also tracks per-rank liveness files
 (runtime/heartbeat.py; the Trainer beats at its device-sync points) and
 treats a rank silent for more than T seconds as failed — kill the group,
 relaunch if restarts remain.
+
+``--elastic-min-nproc M`` enables torchrun's ``--nnodes=min:max`` resize
+semantics (beyond the reference, which pins ``--nproc_per_node=2``,
+ddp_gpus_torchrun.py:102): when the SAME single rank fails twice
+consecutively, the group relaunches one worker smaller (never below M)
+and ranks renumber — capacity reduction so training continues, NOT
+slot exclusion (this launcher assigns no fixed hardware to a rank; a
+failure tied to the rank NUMBER itself would move with the renumbering).
+Shrinks are bounded by ``nproc − M`` and are not charged against
+``--max-restarts``; group-wide failures (more than one nonzero exit, e.g.
+a bad script arg) reset the per-rank tracker and only consume restarts.
+Observing a repeat takes one same-size relaunch, so the flag needs
+``--max-restarts ≥ 1`` to ever fire. Workers read the new WORLD_SIZE from
+the env contract and re-shard their data accordingly; note the Trainer's
+mid-epoch resume geometry guard refuses to fast-forward across a
+world-size change (resume restarts the epoch boundary from the
+checkpoint instead).
 """
 
 from __future__ import annotations
@@ -101,12 +118,23 @@ def main(argv=None) -> int:
     parser.add_argument("--devices-per-proc", type=int, default=None,
                         help="CPU-sim chips per process (sets JAX_PLATFORMS="
                              "cpu + xla_force_host_platform_device_count)")
+    parser.add_argument("--elastic-min-nproc", type=int, default=0,
+                        help="allow the group to relaunch SMALLER (down to "
+                             "this size) when the same rank fails twice in "
+                             "a row — torchrun --nnodes=min:max resize "
+                             "semantics (0 = fixed size)")
     parser.add_argument("script", help="training script to run")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
     worker_argv = [args.script] + args.script_args
     restarts = 0
+    nproc = args.nproc_per_node
+    last_failed, consecutive = None, 0
+    if args.elastic_min_nproc > 0 and args.max_restarts < 1:
+        print("[run] warning: --elastic-min-nproc needs --max-restarts >= 1 "
+              "to observe a repeated failure; it will never fire",
+              file=sys.stderr)
     while True:
         port = _free_port()
         # fresh heartbeat dir per incarnation: a relaunch must not inherit
@@ -114,21 +142,21 @@ def main(argv=None) -> int:
         hb_dir = (tempfile.mkdtemp(prefix="ptd_heartbeat_")
                   if args.heartbeat_timeout > 0 else None)
         spawned_at = time.time()
-        procs = _spawn_group(worker_argv, args.nproc_per_node, port,
+        procs = _spawn_group(worker_argv, nproc, port,
                              args.devices_per_proc, hb_dir)
-        failed_rank, why = None, "failed"
-        while failed_rank is None:
+        failed, why = [], "failed"
+        while not failed:
             time.sleep(args.monitor_interval)
             codes = [p.poll() for p in procs]
             if any(c not in (None, 0) for c in codes):
-                failed_rank = codes.index(
-                    next(c for c in codes if c not in (None, 0)))
+                failed = [r for r, c in enumerate(codes)
+                          if c not in (None, 0)]
             elif all(c == 0 for c in codes):
                 if hb_dir is not None:
                     shutil.rmtree(hb_dir, ignore_errors=True)
                 return 0
             elif hb_dir is not None:
-                hung = stale_ranks(hb_dir, args.nproc_per_node,
+                hung = stale_ranks(hb_dir, nproc,
                                    timeout=args.heartbeat_timeout,
                                    grace=args.heartbeat_grace,
                                    now=time.time(), baseline=spawned_at)
@@ -136,10 +164,45 @@ def main(argv=None) -> int:
                 # stops beating legitimately while the rest finish up
                 hung = [r for r in hung if codes[r] is None]
                 if hung:
-                    failed_rank, why = hung[0], "hung (heartbeat stale)"
+                    failed, why = hung, "hung (heartbeat stale)"
+        # settle window before attributing single-vs-group: in a
+        # group-wide crash (or group-wide collective wedge) the siblings
+        # fail within moments of the first-seen member, and sampling too
+        # early would misread it as one bad rank. Floored at 0.5 s —
+        # monitor-interval alone can be shorter than sibling skew.
+        time.sleep(max(args.monitor_interval, 0.5))
+        codes = [p.poll() for p in procs]
+        if why == "failed":
+            failed = [r for r, c in enumerate(codes)
+                      if c not in (None, 0)]
+        else:  # hung: recollect the full stale cohort
+            stale = stale_ranks(hb_dir, nproc,
+                                timeout=args.heartbeat_timeout,
+                                grace=args.heartbeat_grace,
+                                now=time.time(), baseline=spawned_at)
+            failed = [r for r in stale if codes[r] is None] or failed
         _kill_group(procs)
         if hb_dir is not None:  # each incarnation gets a fresh dir
             shutil.rmtree(hb_dir, ignore_errors=True)
+        failed_rank = failed[0]
+        if len(failed) > 1:
+            # group-wide failure (bad args, rendezvous breakage): never
+            # evidence of one bad rank — don't let it drive a shrink
+            last_failed, consecutive = None, 0
+        else:
+            consecutive = (consecutive + 1 if failed_rank == last_failed
+                           else 1)
+            last_failed = failed_rank
+        if (args.elastic_min_nproc > 0 and consecutive >= 2
+                and nproc - 1 >= args.elastic_min_nproc):
+            # the same single rank twice in a row: continue smaller. Not
+            # charged against --max-restarts — shrinks are bounded by
+            # nproc − min on their own.
+            nproc -= 1
+            last_failed, consecutive = None, 0
+            print(f"[run] rank {failed_rank} {why} twice; resizing group "
+                  f"to {nproc} (elastic)", file=sys.stderr)
+            continue
         if restarts >= args.max_restarts:
             print(f"[run] rank {failed_rank} {why}; no restarts left",
                   file=sys.stderr)
