@@ -154,12 +154,20 @@ def bench_gpt2(size: str = "small") -> dict:
     return result
 
 
-def bench_llama1b() -> dict:
+def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
+                  metric: str = "llama1b_train_tokens_per_s") -> dict:
     """Llama-1B (RMSNorm/SwiGLU/RoPE/GQA) single-chip training. Fastest
     measured v5e fit: adafactor (fp32 adamw state for 1.1B params alone
-    exceeds the chip's 16G HBM), fused chunked-CE head, selective remat
-    keeping all dot outputs. MFU here beats the GPT-2 bench's shape ceiling
-    story: 2048-dim matmuls run the MXU harder than 768-dim ones."""
+    exceeds the chip's 16G HBM), fused chunked-CE head, unrolled layers
+    (the 16-tick scan costs ~8% in while-loop scheduling), selective remat
+    keeping all dot outputs; batch 8 at S=1024 (12+ OOMs; sweep in
+    BASELINE.md). MFU here beats the GPT-2 bench's shape ceiling story:
+    2048-dim matmuls run the MXU harder than 768-dim ones. The
+    "longcontext" bench is the same recipe at (2, 4096) — the same global
+    token count, so tokens/s compares the cost of sequence length
+    directly; causal flash tiles the longer sequence with the same
+    block-1024 grid, and the multi-chip continuation is ring/Ulysses
+    sequence parallelism (examples/long_context.py)."""
     import optax
 
     from pytorchdistributed_tpu.models import Llama, llama_config
@@ -170,11 +178,7 @@ def bench_llama1b() -> dict:
     )
 
     import jax
-    batch_size, seq_len = 8, 1024
     attention = "pallas" if jax.default_backend() == "tpu" else "dense"
-    # Fastest measured v5e fit (sweep in BASELINE.md): unrolled layers
-    # (the 16-tick scan costs ~8% in while-loop scheduling), batch 8
-    # (12+ OOMs), selective remat keeping all dot outputs.
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
                        remat=True, remat_policy="dots_all",
                        scan_layers=False)
@@ -190,7 +194,7 @@ def bench_llama1b() -> dict:
     }
     sec = _time_steps(trainer, batch, steps=10)
     tokens = batch_size * seq_len
-    result = {"metric": "llama1b_train_tokens_per_s",
+    result = {"metric": metric,
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
@@ -565,6 +569,9 @@ def bench_scaling_sim() -> dict:
 
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "gpt2medium": functools.partial(bench_gpt2, "medium"),
+           "longcontext": functools.partial(
+               bench_llama1b, batch_size=2, seq_len=4096,
+               metric="llama1b_s4096_train_tokens_per_s"),
            "resnet50": bench_resnet50, "generate": bench_generate,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}

@@ -55,6 +55,12 @@ class TransformerConfig:
     # failed llama-7b auto-shard cell, 03_model_parallel.ipynb:86-89 — flips
     # all four). One shared core: every strategy applies to every dialect.
     norm: str = "layernorm"             # layernorm | rmsnorm
+    # Fused custom_vjp norm backward (ops/norms.py) targeting the r3
+    # profile's ~64 ms/step of norm-backward reduce fusions. Opt-in until
+    # measured on the chip (baseline discipline: no unmeasured perf change
+    # rides a recorded config; the tunnel was down when this landed —
+    # flip the default once the A/B is captured).
+    fused_norms: bool = False
     activation: str = "gelu"            # gelu | swiglu
     rope: bool = False                  # rotary position embedding (no
     #                                     learned pos table when True)
@@ -420,26 +426,41 @@ class MlpBlock(nn.Module):
 
 
 def _layer_norm(cfg, name):
-    """Fused-backward norms (ops/norms.py): fp32 normalization math like
-    the flax originals (same param trees, so checkpoints are unchanged),
-    but the custom_vjp keeps bf16 residuals + row stats instead of AD's
-    fp32 intermediates — the r3 profile's ~64 ms/step of norm-backward
-    reduce fusions on Llama-1B (BASELINE.md)."""
-    from pytorchdistributed_tpu.ops.norms import FusedLayerNorm, FusedRMSNorm
+    """cfg.fused_norms=True: the custom_vjp norms (ops/norms.py) — fp32
+    normalization math like the flax originals (same param trees, so
+    checkpoints are unchanged), but bf16-input + row-stat residuals and a
+    single-fusion backward instead of AD's saved fp32 intermediates (the
+    r3 profile's ~64 ms/step of norm-backward reduce fusions on Llama-1B,
+    BASELINE.md). Default: the flax modules, until the A/B is measured on
+    the chip."""
+    scale_init = nn.with_logical_partitioning(
+        nn.initializers.ones_init(), (Logical.EMBED,))
+    bias_init = nn.with_logical_partitioning(
+        nn.initializers.zeros_init(), (Logical.EMBED,))
+    if cfg.fused_norms:
+        from pytorchdistributed_tpu.ops.norms import (
+            FusedLayerNorm,
+            FusedRMSNorm,
+        )
 
+        if cfg.norm == "rmsnorm":
+            return FusedRMSNorm(param_dtype=cfg.param_dtype,
+                                scale_init=scale_init, name=name)
+        return FusedLayerNorm(param_dtype=cfg.param_dtype,
+                              scale_init=scale_init, bias_init=bias_init,
+                              name=name)
     if cfg.norm == "rmsnorm":
-        return FusedRMSNorm(
+        return nn.RMSNorm(
+            dtype=jnp.float32,
             param_dtype=cfg.param_dtype,
-            scale_init=nn.with_logical_partitioning(
-                nn.initializers.ones_init(), (Logical.EMBED,)),
+            scale_init=scale_init,
             name=name,
         )
-    return FusedLayerNorm(
+    return nn.LayerNorm(
+        dtype=jnp.float32,  # normalize in fp32 regardless of compute dtype
         param_dtype=cfg.param_dtype,
-        scale_init=nn.with_logical_partitioning(
-            nn.initializers.ones_init(), (Logical.EMBED,)),
-        bias_init=nn.with_logical_partitioning(
-            nn.initializers.zeros_init(), (Logical.EMBED,)),
+        scale_init=scale_init,
+        bias_init=bias_init,
         name=name,
     )
 

@@ -30,10 +30,13 @@ from pytorchdistributed_tpu.runtime.mesh import Axis
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                    scale: float | None, impl: str, interpret: bool):
     n = lax.axis_size(axis_name)
-    if q.shape[2] % n != 0:
+    if q.shape[2] % n != 0 or k.shape[2] % n != 0:
+        # k/v may carry fewer heads than q (grouped-query); BOTH counts
+        # must split over the shards for the all-to-alls to tile
         raise ValueError(
-            f"Ulysses needs heads ({q.shape[2]}) divisible by the seq axis "
-            f"size ({n}); use ring attention otherwise")
+            f"Ulysses needs q heads ({q.shape[2]}) and kv heads "
+            f"({k.shape[2]}) divisible by the seq axis size ({n}); use "
+            f"ring attention otherwise")
     # [B, S/n, H, D] -> [B, S, H/n, D]: split heads, gather sequence.
     to_heads = functools.partial(
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,

@@ -172,15 +172,27 @@ def main(argv=None) -> int:
         # monitor-interval alone can be shorter than sibling skew.
         time.sleep(max(args.monitor_interval, 0.5))
         codes = [p.poll() for p in procs]
+        if all(c == 0 for c in codes):
+            # the "hung" rank was finishing up (e.g. a slow final
+            # checkpoint save outlived the heartbeat timeout) and the
+            # whole group completed during the settle — that's success,
+            # not a failure to relaunch
+            if hb_dir is not None:
+                shutil.rmtree(hb_dir, ignore_errors=True)
+            return 0
+        exited = [r for r, c in enumerate(codes) if c not in (None, 0)]
         if why == "failed":
-            failed = [r for r, c in enumerate(codes)
-                      if c not in (None, 0)]
-        else:  # hung: recollect the full stale cohort
+            failed = exited
+        else:
+            # hung: the full cohort is the still-live stale ranks PLUS any
+            # sibling that crashed out during the settle — a group-wide
+            # wedge must not be attributed to the first-stale rank
             stale = stale_ranks(hb_dir, nproc,
                                 timeout=args.heartbeat_timeout,
                                 grace=args.heartbeat_grace,
                                 now=time.time(), baseline=spawned_at)
-            failed = [r for r in stale if codes[r] is None] or failed
+            failed = (sorted(set(r for r in stale if codes[r] is None)
+                             | set(exited)) or failed)
         _kill_group(procs)
         if hb_dir is not None:  # each incarnation gets a fresh dir
             shutil.rmtree(hb_dir, ignore_errors=True)

@@ -59,6 +59,37 @@ def test_layernorm_matches_flax(dtype):
                                    np.asarray(b, np.float32), **tol)
 
 
+def test_fused_norms_config_matches_default():
+    """cfg.fused_norms=True is a drop-in: identical param trees (same init)
+    and a training loss curve matching the flax-norm path to fp32
+    tolerance, for both norm dialects."""
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import local_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    rng = np.random.default_rng(11)
+    batch = {
+        "tokens": rng.integers(0, 128, (8, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (8, 16)).astype(np.int32),
+    }
+    for norm in ("layernorm", "rmsnorm"):
+        losses = {}
+        for fused in (False, True):
+            model = GPT2(gpt2_config("test", dtype=np.float32, norm=norm,
+                                     fused_norms=fused))
+            tr = Trainer(model, optax.sgd(1e-2), token_cross_entropy_loss,
+                         mesh=local_mesh(1), log_every=10**9)
+            losses[fused] = [float(tr.train_step(batch)["loss"])
+                             for _ in range(3)]
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=2e-5, atol=1e-6)
+
+
 def test_fused_modules_param_trees_match_flax():
     """Checkpoint compatibility: same param names/shapes as the flax
     modules they replace."""
