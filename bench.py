@@ -113,6 +113,16 @@ def _time_steps(trainer, batch, *, warmup: int = 2, steps: int = 20) -> float:
     return (time.perf_counter() - t0) / steps
 
 
+def _fused_norms_override() -> bool:
+    """PTD_FUSED_NORMS=1 flips the transformer benches onto the custom_vjp
+    norm backward (TransformerConfig.fused_norms) for the chip A/B — the
+    committed configs stay on the flax norms until that A/B is captured
+    (BASELINE.md round-4 notes)."""
+    import os
+
+    return os.environ.get("PTD_FUSED_NORMS") == "1"
+
+
 def bench_gpt2(size: str = "small") -> dict:
     import optax
 
@@ -132,7 +142,8 @@ def bench_gpt2(size: str = "small") -> dict:
     # 47.4% MFU, the 1024-wide-matmul shape dividend over small's 45.9%).
     # remat="dots" is the fallback for bigger models/batches (config.py).
     cfg = gpt2_config(size, attention=attention, remat=False,
-                      scan_layers=False)
+                      scan_layers=False,
+                      fused_norms=_fused_norms_override())
     model = GPT2(cfg)
     trainer = Trainer(model, optax.adamw(3e-4), token_cross_entropy_loss,
                       mesh=create_mesh(), strategy="dp", log_every=10**9)
@@ -181,7 +192,8 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     attention = "pallas" if jax.default_backend() == "tpu" else "dense"
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
                        remat=True, remat_policy="dots_all",
-                       scan_layers=False)
+                       scan_layers=False,
+                       fused_norms=_fused_norms_override())
     trainer = Trainer(Llama(cfg), optax.adafactor(3e-3),
                       fused_token_cross_entropy_loss, mesh=create_mesh(),
                       strategy="dp", log_every=10**9)

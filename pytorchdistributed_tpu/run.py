@@ -148,9 +148,10 @@ def main(argv=None) -> int:
         while not failed:
             time.sleep(args.monitor_interval)
             codes = [p.poll() for p in procs]
+            suspect, why = [], "failed"
             if any(c not in (None, 0) for c in codes):
-                failed = [r for r, c in enumerate(codes)
-                          if c not in (None, 0)]
+                suspect = [r for r, c in enumerate(codes)
+                           if c not in (None, 0)]
             elif all(c == 0 for c in codes):
                 if hb_dir is not None:
                     shutil.rmtree(hb_dir, ignore_errors=True)
@@ -164,35 +165,39 @@ def main(argv=None) -> int:
                 # stops beating legitimately while the rest finish up
                 hung = [r for r in hung if codes[r] is None]
                 if hung:
-                    failed, why = hung, "hung (heartbeat stale)"
-        # settle window before attributing single-vs-group: in a
-        # group-wide crash (or group-wide collective wedge) the siblings
-        # fail within moments of the first-seen member, and sampling too
-        # early would misread it as one bad rank. Floored at 0.5 s —
-        # monitor-interval alone can be shorter than sibling skew.
-        time.sleep(max(args.monitor_interval, 0.5))
-        codes = [p.poll() for p in procs]
-        if all(c == 0 for c in codes):
-            # the "hung" rank was finishing up (e.g. a slow final
-            # checkpoint save outlived the heartbeat timeout) and the
-            # whole group completed during the settle — that's success,
-            # not a failure to relaunch
-            if hb_dir is not None:
-                shutil.rmtree(hb_dir, ignore_errors=True)
-            return 0
-        exited = [r for r, c in enumerate(codes) if c not in (None, 0)]
-        if why == "failed":
-            failed = exited
-        else:
-            # hung: the full cohort is the still-live stale ranks PLUS any
-            # sibling that crashed out during the settle — a group-wide
-            # wedge must not be attributed to the first-stale rank
-            stale = stale_ranks(hb_dir, nproc,
-                                timeout=args.heartbeat_timeout,
-                                grace=args.heartbeat_grace,
-                                now=time.time(), baseline=spawned_at)
-            failed = (sorted(set(r for r in stale if codes[r] is None)
-                             | set(exited)) or failed)
+                    suspect, why = hung, "hung (heartbeat stale)"
+            if not suspect:
+                continue
+            # settle window before attributing single-vs-group: in a
+            # group-wide crash (or group-wide collective wedge) the
+            # siblings fail within moments of the first-seen member, and
+            # sampling too early would misread it as one bad rank.
+            # Floored at 0.5 s — monitor-interval alone can be shorter
+            # than sibling skew.
+            time.sleep(max(args.monitor_interval, 0.5))
+            codes = [p.poll() for p in procs]
+            if all(c == 0 for c in codes):
+                # the suspects were finishing up (e.g. a slow final
+                # checkpoint save outlived the heartbeat timeout) and the
+                # whole group completed during the settle — success
+                if hb_dir is not None:
+                    shutil.rmtree(hb_dir, ignore_errors=True)
+                return 0
+            exited = [r for r, c in enumerate(codes)
+                      if c not in (None, 0)]
+            if why == "failed":
+                failed = exited  # nonzero codes are stable: non-empty
+            else:
+                # hung: the cohort is the still-live stale ranks PLUS any
+                # sibling that crashed during the settle. Empty cohort =
+                # false alarm (the stale rank exited 0 while siblings
+                # keep working) — resume monitoring, nothing failed.
+                stale = stale_ranks(hb_dir, nproc,
+                                    timeout=args.heartbeat_timeout,
+                                    grace=args.heartbeat_grace,
+                                    now=time.time(), baseline=spawned_at)
+                failed = sorted(set(r for r in stale if codes[r] is None)
+                                | set(exited))
         _kill_group(procs)
         if hb_dir is not None:  # each incarnation gets a fresh dir
             shutil.rmtree(hb_dir, ignore_errors=True)

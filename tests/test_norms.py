@@ -90,6 +90,38 @@ def test_fused_norms_config_matches_default():
                                    rtol=2e-5, atol=1e-6)
 
 
+def test_fused_norms_compose_with_remat_and_fsdp():
+    """The bench configs that would flip fused_norms on run remat
+    (Llama: dots_all) and sharded params — the custom_vjp must hold its
+    equivalence under jax.checkpoint recompute and a ZeRO-3 sharded scale
+    param."""
+    import optax
+
+    from pytorchdistributed_tpu.models import Llama, llama_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    rng = np.random.default_rng(12)
+    batch = {
+        "tokens": rng.integers(0, 128, (16, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (16, 16)).astype(np.int32),
+    }
+    losses = {}
+    for fused in (False, True):
+        cfg = llama_config("test", dtype=np.float32, fused_norms=fused,
+                           remat=True, remat_policy="dots_all")
+        tr = Trainer(Llama(cfg), optax.sgd(1e-2), token_cross_entropy_loss,
+                     mesh=create_mesh(data=2, fsdp=4), strategy="fsdp",
+                     remat=True, log_every=10**9)
+        losses[fused] = [float(tr.train_step(batch)["loss"])
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=2e-5, atol=1e-6)
+
+
 def test_fused_modules_param_trees_match_flax():
     """Checkpoint compatibility: same param names/shapes as the flax
     modules they replace."""
