@@ -189,9 +189,15 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     )
 
     import jax
+    import os
     attention = "pallas" if jax.default_backend() == "tpu" else "dense"
+    # capture-time A/B knobs (BASELINE.md runbook): batch size and remat
+    # policy sweeps without code edits; the committed config is the
+    # measured-fastest and stays the default
+    batch_size = int(os.environ.get("PTD_BENCH_BS", batch_size))
+    remat_policy = os.environ.get("PTD_REMAT_POLICY", "dots_all")
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
-                       remat=True, remat_policy="dots_all",
+                       remat=True, remat_policy=remat_policy,
                        scan_layers=False,
                        fused_norms=_fused_norms_override())
     trainer = Trainer(Llama(cfg), optax.adafactor(3e-3),
@@ -208,6 +214,13 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     tokens = batch_size * seq_len
     result = {"metric": metric,
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
+    # any active A/B knob is stamped into the record: a number captured
+    # under an override must never be mistaken for the committed config's
+    overrides = {k: os.environ[k] for k in
+                 ("PTD_BENCH_BS", "PTD_REMAT_POLICY", "PTD_FUSED_NORMS")
+                 if k in os.environ}
+    if overrides:
+        result["overrides"] = overrides
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu

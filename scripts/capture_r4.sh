@@ -1,0 +1,41 @@
+#!/bin/bash
+# Tunnel-return bench capture (BASELINE.md "Tunnel-return capture runbook").
+# One shot: verified re-capture of every headline bench, then the r4 A/B
+# knobs (fused norms; Llama remat/batch sweep). Each bench runs in its own
+# process; a wedged tunnel fail-fasts via bench.py's probe (rc=2).
+#
+#   bash scripts/capture_r4.sh            # -> BENCH_r04_local.jsonl
+#   bash scripts/capture_r4.sh out.jsonl
+set -u
+cd "$(dirname "$0")/.."
+out=${1:-BENCH_r04_local.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+run() {  # run <label> <env...> -- <bench>
+  local label=$1; shift
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  local bench=$1
+  echo "{\"capture\": \"$label\", \"at\": \"$(stamp)\"}" >> "$out"
+  if env "${envs[@]}" timeout 1800 python bench.py --bench "$bench" \
+      >> "$out" 2> "/tmp/capture_${label}.err"; then
+    echo "capture $label: ok"
+  else
+    echo "{\"capture\": \"$label\", \"failed\": true, \"rc\": $?}" >> "$out"
+    echo "capture $label: FAILED (see /tmp/capture_${label}.err)"
+  fi
+}
+
+# 1. verified re-capture of the r3 claims (VERDICT r3 next #1)
+for b in gpt2 gpt2medium llama1b resnet50 generate longcontext sweep; do
+  run "$b" -- "$b"
+done
+# 2. fused-norms A/B (flip TransformerConfig.fused_norms default iff it wins)
+run llama1b_fused PTD_FUSED_NORMS=1 -- llama1b
+run gpt2medium_fused PTD_FUSED_NORMS=1 -- gpt2medium
+# 3. Llama remat-policy and batch headroom probes
+run llama1b_dots_norms PTD_REMAT_POLICY=dots_norms -- llama1b
+run llama1b_bs12 PTD_BENCH_BS=12 PTD_REMAT_POLICY=dots_norms -- llama1b
+
+echo "capture complete -> $out"
