@@ -120,7 +120,8 @@ def bert_config(size: str = "base", **overrides) -> TransformerConfig:
         "base": dict(num_layers=12, embed_dim=768, num_heads=12),
         "large": dict(num_layers=24, embed_dim=1024, num_heads=16),
     }
-    kw = dict(vocab_size=30522, max_seq_len=512, causal=False)
+    kw = dict(vocab_size=30522, max_seq_len=512, causal=False,
+              norm_eps=1e-12)  # BERT's released layer_norm_eps
     kw.update(presets[size])
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -133,7 +133,8 @@ def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:
         "large": dict(num_layers=36, embed_dim=1280, num_heads=20),
         "xl": dict(num_layers=48, embed_dim=1600, num_heads=25),
     }
-    kw = dict(vocab_size=50257, max_seq_len=1024, causal=True)
+    kw = dict(vocab_size=50257, max_seq_len=1024, causal=True,
+              norm_eps=1e-5)  # GPT-2's released layer_norm_epsilon
     kw.update(presets[size])
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -114,7 +114,8 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
     }
     kw = dict(vocab_size=32000, max_seq_len=4096, causal=True,
               norm="rmsnorm", activation="swiglu", rope=True,
-              num_kv_heads=None, use_bias=False, tie_embeddings=False)
+              num_kv_heads=None, use_bias=False, tie_embeddings=False,
+              norm_eps=1e-5)  # Llama's released rms_norm_eps
     kw.update(presets[size])
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -1,0 +1,144 @@
+"""PyTorch → TPU-framework weight import (GPT-2 and Llama families).
+
+The migration story for users of the reference stack: take the
+``state_dict`` of a torch/HuggingFace model — the ecosystem the reference
+trains in — and load it into this framework's param trees, so a
+torch-pretrained checkpoint serves, fine-tunes, and shards here without
+retraining. Pure tensor re-layout on host numpy: no torch autograd, no
+device work, and transformers is only needed by the tests.
+
+Conventions handled:
+  * HF GPT-2 stores ``Conv1D`` weights ``[in, out]`` (y = x@W + b) — no
+    transpose; Llama stores ``nn.Linear`` weights ``[out, in]`` —
+    transposed on import.
+  * Our fused stacks: GPT-2 ``qkv_kernel [E, 3, H·D]`` from c_attn's
+    contiguous q|k|v columns; Llama ``kv_kernel [E, 2, KV·D]`` and
+    swiglu ``wi_kernel [E, 2, F]`` (index 0 = gate/silu, 1 = up — the
+    convention in models/transformer.py MlpBlock).
+  * ``scan_layers=True`` trees stack the per-layer leaves on a leading
+    layer axis (``h.block``); unrolled trees use ``h.block_{i}``.
+  * Norm epsilons must already match via the family presets' ``norm_eps``
+    (gpt2 1e-5, llama 1e-5, bert 1e-12) — logit-level parity vs the torch
+    forward is asserted in tests/test_torch_import.py.
+
+Tensors are converted via ``.detach().cpu().numpy()`` when torch tensors
+are passed; plain numpy arrays work too (e.g. from a safetensors reader).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch.Tensor without importing torch
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _stack_blocks(blocks: list[dict], scan_layers: bool) -> dict:
+    """Per-layer param subtrees → the stack's tree: stacked on a leading
+    layer axis under "block" (scan_layers) or "block_{i}" children."""
+    if not scan_layers:
+        return {f"block_{i}": b for i, b in enumerate(blocks)}
+    import jax
+
+    return {"block": jax.tree.map(lambda *ls: np.stack(ls), *blocks)}
+
+
+def gpt2_params_from_torch(state_dict, cfg) -> dict:
+    """HF ``GPT2LMHeadModel.state_dict()`` → ``{"params": ...}`` for
+    models/gpt2.GPT2 built with ``gpt2_config(...)`` (tied embeddings).
+
+    Accepts keys with or without the ``transformer.`` prefix. ``wpe`` may
+    be longer than ``cfg.max_seq_len`` (sliced); shorter raises.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    e = cfg.embed_dim
+    if not cfg.tie_embeddings:
+        raise ValueError("GPT-2 import expects tie_embeddings=True "
+                         "(the released models tie wte and lm_head)")
+    wpe = _np(sd["wpe.weight"])
+    if wpe.shape[0] < cfg.max_seq_len:
+        raise ValueError(
+            f"checkpoint has {wpe.shape[0]} positions < cfg.max_seq_len "
+            f"{cfg.max_seq_len}")
+
+    def block(i):
+        p = f"h.{i}."
+        qkv_w = _np(sd[p + "attn.c_attn.weight"])       # [E, 3E], x@W
+        qkv_b = _np(sd[p + "attn.c_attn.bias"])         # [3E]
+        return {
+            "ln1": {"scale": _np(sd[p + "ln_1.weight"]),
+                    "bias": _np(sd[p + "ln_1.bias"])},
+            "ln2": {"scale": _np(sd[p + "ln_2.weight"]),
+                    "bias": _np(sd[p + "ln_2.bias"])},
+            "attn": {
+                "qkv_kernel": qkv_w.reshape(e, 3, e),
+                "qkv_bias": qkv_b.reshape(3, e),
+                "out": {"kernel": _np(sd[p + "attn.c_proj.weight"]),
+                        "bias": _np(sd[p + "attn.c_proj.bias"])},
+            },
+            "mlp": {
+                "wi": {"kernel": _np(sd[p + "mlp.c_fc.weight"]),
+                       "bias": _np(sd[p + "mlp.c_fc.bias"])},
+                "wo": {"kernel": _np(sd[p + "mlp.c_proj.weight"]),
+                       "bias": _np(sd[p + "mlp.c_proj.bias"])},
+            },
+        }
+
+    return {"params": {
+        "embed": {"tok": {"embedding": _np(sd["wte.weight"])},
+                  "pos": wpe[: cfg.max_seq_len]},
+        "h": _stack_blocks([block(i) for i in range(cfg.num_layers)],
+                           cfg.scan_layers),
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+    }}
+
+
+def llama_params_from_torch(state_dict, cfg) -> dict:
+    """HF ``LlamaForCausalLM.state_dict()`` → ``{"params": ...}`` for
+    models/llama.Llama built with ``llama_config(...)``."""
+    if cfg.tie_embeddings:
+        raise ValueError(
+            "Llama import expects tie_embeddings=False (the released "
+            "models carry a separate lm_head; a tied config would "
+            "silently drop it)")
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def lin(key):  # torch Linear [out, in] → ours [in, out]
+        return _np(sd[key]).T
+
+    def block(i):
+        p = f"layers.{i}."
+        q = lin(p + "self_attn.q_proj.weight")   # [E, H·D]
+        k = lin(p + "self_attn.k_proj.weight")   # [E, KV·D]
+        v = lin(p + "self_attn.v_proj.weight")
+        if cfg.kv_heads == cfg.num_heads:
+            # MHA sizes (7b/13b): SelfAttention uses the single fused
+            # [E, 3, H·D] qkv stack, not the GQA q+kv split
+            attn = {"qkv_kernel": np.stack([q, k, v], axis=1)}
+        else:
+            attn = {"q_kernel": q, "kv_kernel": np.stack([k, v], axis=1)}
+        attn["out"] = {"kernel": lin(p + "self_attn.o_proj.weight")}
+        gate = lin(p + "mlp.gate_proj.weight")   # [E, F]
+        up = lin(p + "mlp.up_proj.weight")
+        return {
+            "ln1": {"scale": _np(sd[p + "input_layernorm.weight"])},
+            "ln2": {"scale":
+                    _np(sd[p + "post_attention_layernorm.weight"])},
+            "attn": attn,
+            "mlp": {
+                "wi_kernel": np.stack([gate, up], axis=1),  # 0=gate 1=up
+                "wo": {"kernel": lin(p + "mlp.down_proj.weight")},
+            },
+        }
+
+    return {"params": {
+        "embed": {"tok": {"embedding": _np(sd["embed_tokens.weight"])}},
+        "h": _stack_blocks([block(i) for i in range(cfg.num_layers)],
+                           cfg.scan_layers),
+        "ln_f": {"scale": _np(sd["norm.weight"])},
+        "lm_head": {"kernel": _np(state_dict["lm_head.weight"]).T},
+    }}

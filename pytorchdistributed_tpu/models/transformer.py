@@ -55,6 +55,10 @@ class TransformerConfig:
     # failed llama-7b auto-shard cell, 03_model_parallel.ipynb:86-89 — flips
     # all four). One shared core: every strategy applies to every dialect.
     norm: str = "layernorm"             # layernorm | rmsnorm
+    # Normalization epsilon. Family presets pin the released models'
+    # values (GPT-2/Llama 1e-5, BERT 1e-12) so torch-trained checkpoints
+    # import bit-faithfully (models/torch_import.py).
+    norm_eps: float = 1e-6
     # Fused custom_vjp norm backward (ops/norms.py) targeting the r3
     # profile's ~64 ms/step of norm-backward reduce fusions. Opt-in until
     # measured on the chip (baseline discipline: no unmeasured perf change
@@ -444,19 +448,23 @@ def _layer_norm(cfg, name):
         )
 
         if cfg.norm == "rmsnorm":
-            return FusedRMSNorm(param_dtype=cfg.param_dtype,
+            return FusedRMSNorm(epsilon=cfg.norm_eps,
+                                param_dtype=cfg.param_dtype,
                                 scale_init=scale_init, name=name)
-        return FusedLayerNorm(param_dtype=cfg.param_dtype,
+        return FusedLayerNorm(epsilon=cfg.norm_eps,
+                              param_dtype=cfg.param_dtype,
                               scale_init=scale_init, bias_init=bias_init,
                               name=name)
     if cfg.norm == "rmsnorm":
         return nn.RMSNorm(
+            epsilon=cfg.norm_eps,
             dtype=jnp.float32,
             param_dtype=cfg.param_dtype,
             scale_init=scale_init,
             name=name,
         )
     return nn.LayerNorm(
+        epsilon=cfg.norm_eps,
         dtype=jnp.float32,  # normalize in fp32 regardless of compute dtype
         param_dtype=cfg.param_dtype,
         scale_init=scale_init,
