@@ -41,10 +41,17 @@ class BertMLM(nn.Module):
         # pair would map to an invalid duplicate mesh axis).
         x = _dense_general(cfg.embed_dim, (Logical.EMBED, Logical.MLP), cfg,
                            "mlm_dense")(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=cfg.gelu_approximate)
         x = _layer_norm(cfg, "mlm_ln")(x)
         logits = emb.attend(x)
-        return logits.astype(jnp.float32)
+        # BERT's cls.predictions decoder bias: tied weights + a free [V]
+        # bias (torch_import maps it directly)
+        bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         (Logical.VOCAB,)),
+            (cfg.vocab_size,), cfg.param_dtype)
+        return (logits + bias).astype(jnp.float32)
 
     @nn.nowrap
     def pipeline_parts(self):
@@ -65,7 +72,8 @@ class BertMLM(nn.Module):
             pp = params["params"]
             stage = stack_to_stages(pp["encoder"]["block"], cfg)
             head = {"mlm_dense": pp["mlm_dense"], "mlm_ln": pp["mlm_ln"],
-                    "proj": pp["embed"]["tok"]["embedding"]}
+                    "proj": pp["embed"]["tok"]["embedding"],
+                    "mlm_bias": pp["mlm_bias"]}
             pre = {"embed": pp["embed"], "ln_embed": pp["ln_embed"]}
             return pre, stage, head
 
@@ -86,9 +94,10 @@ class BertMLM(nn.Module):
             x = _dense_general(
                 cfg.embed_dim, (Logical.EMBED, Logical.MLP), cfg,
                 None).apply({"params": head["mlm_dense"]}, h)
-            x = nn.gelu(x)
+            x = nn.gelu(x, approximate=cfg.gelu_approximate)
             x = _layer_norm(cfg, None).apply({"params": head["mlm_ln"]}, x)
-            logits = x.astype(cfg.dtype) @ head["proj"].astype(cfg.dtype).T
+            logits = (x.astype(cfg.dtype) @ head["proj"].astype(cfg.dtype).T
+                      + head["mlm_bias"].astype(cfg.dtype))
             ce = gather_free_ce(logits, t["targets"])
             # x M: the schedule averages micro-batch losses; the global
             # weights w already carry the 1/Σmask normalization
@@ -104,6 +113,7 @@ class BertMLM(nn.Module):
                 "encoder": {"block": blocks},
                 "mlm_dense": head_g["mlm_dense"],
                 "mlm_ln": head_g["mlm_ln"],
+                "mlm_bias": head_g["mlm_bias"],
             }}
 
         return PipelineParts(
@@ -120,8 +130,10 @@ def bert_config(size: str = "base", **overrides) -> TransformerConfig:
         "base": dict(num_layers=12, embed_dim=768, num_heads=12),
         "large": dict(num_layers=24, embed_dim=1024, num_heads=16),
     }
+    # Released-BERT fidelity (torch_import): post-LN residual order, exact
+    # erf GELU, layer_norm_eps 1e-12.
     kw = dict(vocab_size=30522, max_seq_len=512, causal=False,
-              norm_eps=1e-12)  # BERT's released layer_norm_eps
+              norm_eps=1e-12, norm_position="post", gelu_approximate=False)
     kw.update(presets[size])
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -99,7 +99,8 @@ class SwitchMoE(nn.Module):
                            tokens.astype(cfg.dtype))
         slots = nn.with_logical_constraint(
             slots, (Logical.EXPERT, None, Logical.EMBED))
-        h = nn.gelu(jnp.einsum("ecd,edf->ecf", slots, wi.astype(cfg.dtype)))
+        h = nn.gelu(jnp.einsum("ecd,edf->ecf", slots, wi.astype(cfg.dtype)),
+                    approximate=cfg.gelu_approximate)
         h = nn.with_logical_constraint(h, (Logical.EXPERT, None, Logical.MLP))
         out_slots = jnp.einsum("ecf,efd->ecd", h, wo.astype(cfg.dtype))
         out = jnp.einsum("gec,ecd->gd", combine.astype(cfg.dtype), out_slots)

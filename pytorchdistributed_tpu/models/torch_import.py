@@ -36,6 +36,29 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _lin(sd, key) -> np.ndarray:
+    """torch ``nn.Linear`` weight ``[out, in]`` → our kernel ``[in, out]``
+    (HF GPT-2's Conv1D is already ``[in, out]`` and skips this)."""
+    return _np(sd[key]).T
+
+
+def _check_positions(pos: np.ndarray, cfg) -> np.ndarray:
+    if pos.shape[0] < cfg.max_seq_len:
+        raise ValueError(
+            f"checkpoint has {pos.shape[0]} positions < cfg.max_seq_len "
+            f"{cfg.max_seq_len}")
+    return pos[: cfg.max_seq_len]
+
+
+def _finish(tree: dict, cfg) -> dict:
+    """Cast every leaf to cfg.param_dtype so the imported tree matches a
+    model-initialized one exactly (a bf16-param config must not silently
+    double its footprint with fp32 leaves)."""
+    import jax
+
+    return jax.tree.map(lambda a: a.astype(cfg.param_dtype), tree)
+
+
 def _stack_blocks(blocks: list[dict], scan_layers: bool) -> dict:
     """Per-layer param subtrees → the stack's tree: stacked on a leading
     layer axis under "block" (scan_layers) or "block_{i}" children."""
@@ -58,11 +81,7 @@ def gpt2_params_from_torch(state_dict, cfg) -> dict:
     if not cfg.tie_embeddings:
         raise ValueError("GPT-2 import expects tie_embeddings=True "
                          "(the released models tie wte and lm_head)")
-    wpe = _np(sd["wpe.weight"])
-    if wpe.shape[0] < cfg.max_seq_len:
-        raise ValueError(
-            f"checkpoint has {wpe.shape[0]} positions < cfg.max_seq_len "
-            f"{cfg.max_seq_len}")
+    wpe = _check_positions(_np(sd["wpe.weight"]), cfg)
 
     def block(i):
         p = f"h.{i}."
@@ -87,14 +106,73 @@ def gpt2_params_from_torch(state_dict, cfg) -> dict:
             },
         }
 
-    return {"params": {
+    return _finish({"params": {
         "embed": {"tok": {"embedding": _np(sd["wte.weight"])},
-                  "pos": wpe[: cfg.max_seq_len]},
+                  "pos": wpe},
         "h": _stack_blocks([block(i) for i in range(cfg.num_layers)],
                            cfg.scan_layers),
         "ln_f": {"scale": _np(sd["ln_f.weight"]),
                  "bias": _np(sd["ln_f.bias"])},
-    }}
+    }}, cfg)
+
+
+def bert_params_from_torch(state_dict, cfg) -> dict:
+    """HF ``BertForMaskedLM.state_dict()`` → ``{"params": ...}`` for
+    models/bert.BertMLM built with ``bert_config(...)`` (post-LN blocks,
+    exact GELU, eps 1e-12 — the preset pins all three).
+
+    Single-segment convention: HF adds ``token_type_embeddings[0]`` to
+    every position when ``token_type_ids`` are all zero (the MLM batch
+    contract here has no segment ids), so that row folds into the
+    position table. The pooler is dropped (MLM never reads it)."""
+    sd = state_dict
+    emb = "bert.embeddings."
+    pos = _check_positions(_np(sd[emb + "position_embeddings.weight"]), cfg)
+    pos = pos + _np(sd[emb + "token_type_embeddings.weight"])[0]
+
+    def lin(key):
+        return _lin(sd, key)
+
+    def block(i):
+        p = f"bert.encoder.layer.{i}."
+        qkv_w = np.stack([lin(p + f"attention.self.{n}.weight")
+                          for n in ("query", "key", "value")], axis=1)
+        qkv_b = np.stack([_np(sd[p + f"attention.self.{n}.bias"])
+                          for n in ("query", "key", "value")])
+        return {
+            "ln1": {"scale": _np(sd[p + "attention.output.LayerNorm.weight"]),
+                    "bias": _np(sd[p + "attention.output.LayerNorm.bias"])},
+            "ln2": {"scale": _np(sd[p + "output.LayerNorm.weight"]),
+                    "bias": _np(sd[p + "output.LayerNorm.bias"])},
+            "attn": {
+                "qkv_kernel": qkv_w,            # stacked [E, 3, E]
+                "qkv_bias": qkv_b,              # [3, E]
+                "out": {"kernel": lin(p + "attention.output.dense.weight"),
+                        "bias": _np(sd[p + "attention.output.dense.bias"])},
+            },
+            "mlp": {
+                "wi": {"kernel": lin(p + "intermediate.dense.weight"),
+                       "bias": _np(sd[p + "intermediate.dense.bias"])},
+                "wo": {"kernel": lin(p + "output.dense.weight"),
+                       "bias": _np(sd[p + "output.dense.bias"])},
+            },
+        }
+
+    t = "cls.predictions.transform."
+    return _finish({"params": {
+        "embed": {
+            "tok": {"embedding": _np(sd[emb + "word_embeddings.weight"])},
+            "pos": pos},
+        "ln_embed": {"scale": _np(sd[emb + "LayerNorm.weight"]),
+                     "bias": _np(sd[emb + "LayerNorm.bias"])},
+        "encoder": _stack_blocks(
+            [block(i) for i in range(cfg.num_layers)], cfg.scan_layers),
+        "mlm_dense": {"kernel": lin(t + "dense.weight"),
+                      "bias": _np(sd[t + "dense.bias"])},
+        "mlm_ln": {"scale": _np(sd[t + "LayerNorm.weight"]),
+                   "bias": _np(sd[t + "LayerNorm.bias"])},
+        "mlm_bias": _np(sd["cls.predictions.bias"]),
+    }}, cfg)
 
 
 def llama_params_from_torch(state_dict, cfg) -> dict:
@@ -107,8 +185,8 @@ def llama_params_from_torch(state_dict, cfg) -> dict:
             "silently drop it)")
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
 
-    def lin(key):  # torch Linear [out, in] → ours [in, out]
-        return _np(sd[key]).T
+    def lin(key):
+        return _lin(sd, key)
 
     def block(i):
         p = f"layers.{i}."
@@ -135,10 +213,10 @@ def llama_params_from_torch(state_dict, cfg) -> dict:
             },
         }
 
-    return {"params": {
+    return _finish({"params": {
         "embed": {"tok": {"embedding": _np(sd["embed_tokens.weight"])}},
         "h": _stack_blocks([block(i) for i in range(cfg.num_layers)],
                            cfg.scan_layers),
         "ln_f": {"scale": _np(sd["norm.weight"])},
         "lm_head": {"kernel": _np(state_dict["lm_head.weight"]).T},
-    }}
+    }}, cfg)

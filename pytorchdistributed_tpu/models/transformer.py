@@ -59,6 +59,13 @@ class TransformerConfig:
     # values (GPT-2/Llama 1e-5, BERT 1e-12) so torch-trained checkpoints
     # import bit-faithfully (models/torch_import.py).
     norm_eps: float = 1e-6
+    # "pre" (GPT-2/Llama/ViT: x + Attn(LN(x))) or "post" (original
+    # BERT: LN(x + Attn(x))) — released BERT checkpoints are post-LN, so
+    # bert_config flips this for architectural fidelity.
+    norm_position: str = "pre"          # pre | post
+    # GELU flavor: tanh approximation (GPT-2's "gelu_new", the flax
+    # default) vs exact erf (BERT's "gelu").
+    gelu_approximate: bool = True
     # Fused custom_vjp norm backward (ops/norms.py) targeting the r3
     # profile's ~64 ms/step of norm-backward reduce fusions. Opt-in until
     # measured on the chip (baseline discipline: no unmeasured perf change
@@ -419,7 +426,7 @@ class MlpBlock(nn.Module):
         else:
             h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
                                "wi", use_bias=cfg.use_bias)(x)
-            h = nn.gelu(h)
+            h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.with_logical_constraint(
             h, (Logical.BATCH, Logical.SEQ, Logical.MLP))
         out = _dense_general(cfg.embed_dim, (Logical.MLP, Logical.EMBED), cfg,
@@ -510,15 +517,21 @@ class TransformerBlock(nn.Module):
             return jax.ad_checkpoint.checkpoint_name(
                 _layer_norm(cfg, tag)(v).astype(cfg.dtype), "norm_out")
 
-        h = norm("ln1", x)
-        x = x + SelfAttention(cfg, self.deterministic, name="attn")(h)
-        h = norm("ln2", x)
-        if cfg.moe_experts > 0:
-            from pytorchdistributed_tpu.models.moe import SwitchMoE
+        def ffn(h):
+            if cfg.moe_experts > 0:
+                from pytorchdistributed_tpu.models.moe import SwitchMoE
 
-            x = x + SwitchMoE(cfg, self.deterministic, name="moe")(h)
+                return SwitchMoE(cfg, self.deterministic, name="moe")(h)
+            return MlpBlock(cfg, self.deterministic, name="mlp")(h)
+
+        attn = SelfAttention(cfg, self.deterministic, name="attn")
+        if cfg.norm_position == "post":
+            # original-BERT residual order: LN AFTER each sublayer's add
+            x = norm("ln1", x + attn(x))
+            x = norm("ln2", x + ffn(x))
         else:
-            x = x + MlpBlock(cfg, self.deterministic, name="mlp")(h)
+            x = x + attn(norm("ln1", x))
+            x = x + ffn(norm("ln2", x))
         return nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 

@@ -97,6 +97,34 @@ def test_llama_import_matches_torch_logits(scan_layers, kv_heads):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_bert_import_matches_torch_logits(scan_layers):
+    from pytorchdistributed_tpu.models import BertMLM, bert_config
+    from pytorchdistributed_tpu.models.torch_import import (
+        bert_params_from_torch,
+    )
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=128, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    torch.manual_seed(2)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+
+    cfg = bert_config("test", dtype=jnp.float32, attention="dense",
+                      scan_layers=scan_layers)
+    params = bert_params_from_torch(hf.state_dict(), cfg)
+
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(torch.asarray(tokens)).logits.numpy()
+    got = BertMLM(cfg).apply(params, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
 def test_llama_import_rejects_tied_embeddings():
     with pytest.raises(ValueError, match="tie_embeddings"):
         llama_params_from_torch(
