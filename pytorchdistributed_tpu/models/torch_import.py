@@ -1,4 +1,4 @@
-"""PyTorch → TPU-framework weight import (GPT-2 and Llama families).
+"""PyTorch → TPU-framework weight import (GPT-2, Llama, BERT, ViT).
 
 The migration story for users of the reference stack: take the
 ``state_dict`` of a torch/HuggingFace model — the ecosystem the reference
@@ -17,8 +17,9 @@ Conventions handled:
     convention in models/transformer.py MlpBlock).
   * ``scan_layers=True`` trees stack the per-layer leaves on a leading
     layer axis (``h.block``); unrolled trees use ``h.block_{i}``.
-  * Norm epsilons must already match via the family presets' ``norm_eps``
-    (gpt2 1e-5, llama 1e-5, bert 1e-12) — logit-level parity vs the torch
+  * Architecture fidelity comes from the family presets: ``norm_eps``
+    (gpt2 1e-5, llama 1e-5, bert/vit 1e-12), BERT's post-LN order and
+    exact GELU, ViT's exact GELU — logit-level parity vs the torch
     forward is asserted in tests/test_torch_import.py.
 
 Tensors are converted via ``.detach().cpu().numpy()`` when torch tensors
@@ -173,6 +174,72 @@ def bert_params_from_torch(state_dict, cfg) -> dict:
                    "bias": _np(sd[t + "LayerNorm.bias"])},
         "mlm_bias": _np(sd["cls.predictions.bias"]),
     }}, cfg)
+
+
+def vit_params_from_torch(state_dict, cfg) -> dict:
+    """HF ``ViTForImageClassification.state_dict()`` → ``{"params": ...}``
+    for models/vit.ViT built with ``vit_config(...)``. Images here are
+    NHWC (the TPU-native layout) — callers feeding torch-preprocessed
+    NCHW arrays transpose at the boundary. ``cfg`` is the ViTConfig."""
+    sd = state_dict
+    tcfg = cfg.transformer
+
+    def lin(key):
+        return _lin(sd, key)
+
+    def block(i):
+        p = f"vit.encoder.layer.{i}."
+        a = p + "attention.attention."
+        qkv_w = np.stack([lin(a + f"{n}.weight")
+                          for n in ("query", "key", "value")], axis=1)
+        qkv_b = np.stack([_np(sd[a + f"{n}.bias"])
+                          for n in ("query", "key", "value")])
+        return {
+            "ln1": {"scale": _np(sd[p + "layernorm_before.weight"]),
+                    "bias": _np(sd[p + "layernorm_before.bias"])},
+            "ln2": {"scale": _np(sd[p + "layernorm_after.weight"]),
+                    "bias": _np(sd[p + "layernorm_after.bias"])},
+            "attn": {
+                "qkv_kernel": qkv_w,            # [E, 3, E]
+                "qkv_bias": qkv_b,              # [3, E]
+                "out": {"kernel": lin(p + "attention.output.dense.weight"),
+                        "bias": _np(sd[p + "attention.output.dense.bias"])},
+            },
+            "mlp": {
+                "wi": {"kernel": lin(p + "intermediate.dense.weight"),
+                       "bias": _np(sd[p + "intermediate.dense.bias"])},
+                "wo": {"kernel": lin(p + "output.dense.weight"),
+                       "bias": _np(sd[p + "output.dense.bias"])},
+            },
+        }
+
+    emb = "vit.embeddings."
+    pos = _np(sd[emb + "position_embeddings"])[0]     # [N+1, E]
+    if pos.shape[0] != cfg.num_patches + 1:
+        # no slicing here (unlike text wpe): the patch grid must match —
+        # a resolution/patch-size mismatch needs interpolation, not a crop
+        raise ValueError(
+            f"checkpoint has {pos.shape[0]} patch positions but the config "
+            f"({cfg.image_size}px / {cfg.patch_size}px patches) needs "
+            f"{cfg.num_patches + 1}")
+    # torch Conv2d kernel [E, C, P, P] → flax NHWC conv kernel [P, P, C, E]
+    patch_w = _np(sd[emb + "patch_embeddings.projection.weight"]
+                  ).transpose(2, 3, 1, 0)
+    return _finish({"params": {
+        "embed": {
+            "patch_embed": {
+                "kernel": patch_w,
+                "bias": _np(sd[emb + "patch_embeddings.projection.bias"])},
+            "cls": _np(sd[emb + "cls_token"]),            # [1, 1, E]
+            "pos_embed": pos,
+        },
+        "encoder": _stack_blocks(
+            [block(i) for i in range(tcfg.num_layers)], tcfg.scan_layers),
+        "ln_f": {"scale": _np(sd["vit.layernorm.weight"]),
+                 "bias": _np(sd["vit.layernorm.bias"])},
+        "head": {"kernel": lin("classifier.weight"),
+                 "bias": _np(sd["classifier.bias"])},
+    }}, tcfg)
 
 
 def llama_params_from_torch(state_dict, cfg) -> dict:

@@ -157,8 +157,11 @@ def vit_config(size: str = "base", *, image_size: int = 224,
         "huge": dict(num_layers=32, embed_dim=1280, num_heads=16,
                      mlp_dim=5120),
     }
+    # Released-ViT fidelity (torch_import): exact erf GELU, eps 1e-12
+    # (pre-LN is ViT's native order already).
     kw = dict(vocab_size=1, causal=False,
-              max_seq_len=(image_size // patch_size) ** 2 + 1)
+              max_seq_len=(image_size // patch_size) ** 2 + 1,
+              norm_eps=1e-12, gelu_approximate=False)
     kw.update(presets[size])
     kw.update(overrides)
     return ViTConfig(

@@ -125,6 +125,35 @@ def test_bert_import_matches_torch_logits(scan_layers):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_vit_import_matches_torch_logits(scan_layers):
+    from pytorchdistributed_tpu.models import ViT, vit_config
+    from pytorchdistributed_tpu.models.torch_import import (
+        vit_params_from_torch,
+    )
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=256, image_size=16, patch_size=8,
+        num_channels=3, hidden_act="gelu", layer_norm_eps=1e-12,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(4)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    # HF default num_labels=2
+
+    cfg = vit_config("test", image_size=16, patch_size=8, num_classes=2,
+                     dtype=jnp.float32, attention="dense",
+                     scan_layers=scan_layers)
+    params = vit_params_from_torch(hf.state_dict(), cfg)
+
+    rng = np.random.default_rng(4)
+    images = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():  # torch wants NCHW
+        want = hf(torch.asarray(images.transpose(0, 3, 1, 2))).logits.numpy()
+    got = ViT(cfg).apply(params, jnp.asarray(images))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
 def test_llama_import_rejects_tied_embeddings():
     with pytest.raises(ValueError, match="tie_embeddings"):
         llama_params_from_torch(
