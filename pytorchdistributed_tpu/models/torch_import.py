@@ -60,6 +60,34 @@ def _finish(tree: dict, cfg) -> dict:
     return jax.tree.map(lambda a: a.astype(cfg.param_dtype), tree)
 
 
+def _hf_encoder_block(sd, p: str, attn: str, ln1: str, ln2: str) -> dict:
+    """One HF post-2018-encoder layer (BERT/ViT share the shape): stacked
+    q/k/v Linears under ``attn`` prefix, dense out/wi/wo, two LayerNorms
+    named ``ln1``/``ln2`` relative to ``p``."""
+    qkv_w = np.stack([_lin(sd, attn + f"{n}.weight")
+                      for n in ("query", "key", "value")], axis=1)
+    qkv_b = np.stack([_np(sd[attn + f"{n}.bias"])
+                      for n in ("query", "key", "value")])
+    return {
+        "ln1": {"scale": _np(sd[p + ln1 + ".weight"]),
+                "bias": _np(sd[p + ln1 + ".bias"])},
+        "ln2": {"scale": _np(sd[p + ln2 + ".weight"]),
+                "bias": _np(sd[p + ln2 + ".bias"])},
+        "attn": {
+            "qkv_kernel": qkv_w,            # [E, 3, E]
+            "qkv_bias": qkv_b,              # [3, E]
+            "out": {"kernel": _lin(sd, p + "attention.output.dense.weight"),
+                    "bias": _np(sd[p + "attention.output.dense.bias"])},
+        },
+        "mlp": {
+            "wi": {"kernel": _lin(sd, p + "intermediate.dense.weight"),
+                   "bias": _np(sd[p + "intermediate.dense.bias"])},
+            "wo": {"kernel": _lin(sd, p + "output.dense.weight"),
+                   "bias": _np(sd[p + "output.dense.bias"])},
+        },
+    }
+
+
 def _stack_blocks(blocks: list[dict], scan_layers: bool) -> dict:
     """Per-layer param subtrees → the stack's tree: stacked on a leading
     layer axis under "block" (scan_layers) or "block_{i}" children."""
@@ -136,28 +164,9 @@ def bert_params_from_torch(state_dict, cfg) -> dict:
 
     def block(i):
         p = f"bert.encoder.layer.{i}."
-        qkv_w = np.stack([lin(p + f"attention.self.{n}.weight")
-                          for n in ("query", "key", "value")], axis=1)
-        qkv_b = np.stack([_np(sd[p + f"attention.self.{n}.bias"])
-                          for n in ("query", "key", "value")])
-        return {
-            "ln1": {"scale": _np(sd[p + "attention.output.LayerNorm.weight"]),
-                    "bias": _np(sd[p + "attention.output.LayerNorm.bias"])},
-            "ln2": {"scale": _np(sd[p + "output.LayerNorm.weight"]),
-                    "bias": _np(sd[p + "output.LayerNorm.bias"])},
-            "attn": {
-                "qkv_kernel": qkv_w,            # stacked [E, 3, E]
-                "qkv_bias": qkv_b,              # [3, E]
-                "out": {"kernel": lin(p + "attention.output.dense.weight"),
-                        "bias": _np(sd[p + "attention.output.dense.bias"])},
-            },
-            "mlp": {
-                "wi": {"kernel": lin(p + "intermediate.dense.weight"),
-                       "bias": _np(sd[p + "intermediate.dense.bias"])},
-                "wo": {"kernel": lin(p + "output.dense.weight"),
-                       "bias": _np(sd[p + "output.dense.bias"])},
-            },
-        }
+        return _hf_encoder_block(sd, p, p + "attention.self.",
+                                 ln1="attention.output.LayerNorm",
+                                 ln2="output.LayerNorm")
 
     t = "cls.predictions.transform."
     return _finish({"params": {
@@ -189,29 +198,9 @@ def vit_params_from_torch(state_dict, cfg) -> dict:
 
     def block(i):
         p = f"vit.encoder.layer.{i}."
-        a = p + "attention.attention."
-        qkv_w = np.stack([lin(a + f"{n}.weight")
-                          for n in ("query", "key", "value")], axis=1)
-        qkv_b = np.stack([_np(sd[a + f"{n}.bias"])
-                          for n in ("query", "key", "value")])
-        return {
-            "ln1": {"scale": _np(sd[p + "layernorm_before.weight"]),
-                    "bias": _np(sd[p + "layernorm_before.bias"])},
-            "ln2": {"scale": _np(sd[p + "layernorm_after.weight"]),
-                    "bias": _np(sd[p + "layernorm_after.bias"])},
-            "attn": {
-                "qkv_kernel": qkv_w,            # [E, 3, E]
-                "qkv_bias": qkv_b,              # [3, E]
-                "out": {"kernel": lin(p + "attention.output.dense.weight"),
-                        "bias": _np(sd[p + "attention.output.dense.bias"])},
-            },
-            "mlp": {
-                "wi": {"kernel": lin(p + "intermediate.dense.weight"),
-                       "bias": _np(sd[p + "intermediate.dense.bias"])},
-                "wo": {"kernel": lin(p + "output.dense.weight"),
-                       "bias": _np(sd[p + "output.dense.bias"])},
-            },
-        }
+        return _hf_encoder_block(sd, p, p + "attention.attention.",
+                                 ln1="layernorm_before",
+                                 ln2="layernorm_after")
 
     emb = "vit.embeddings."
     pos = _np(sd[emb + "position_embeddings"])[0]     # [N+1, E]

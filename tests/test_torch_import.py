@@ -154,6 +154,54 @@ def test_vit_import_matches_torch_logits(scan_layers):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
+def test_imported_weights_survive_checkpoint_roundtrip(tmp_path):
+    """Interop with the checkpoint system: imported torch weights saved via
+    the sharded CheckpointManager and restored into a fresh Trainer still
+    reproduce the torch logits — the full migration path (torch ->
+    import -> orbax -> serve)."""
+    import optax
+
+    from pytorchdistributed_tpu.runtime.mesh import local_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+    from pytorchdistributed_tpu.training.trainer import TrainState
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+        activation_function="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    torch.manual_seed(5)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = gpt2_config("test", dtype=jnp.float32, attention="dense",
+                      scan_layers=False)
+    params = gpt2_params_from_torch(hf.state_dict(), cfg)
+
+    batch = {"tokens": np.zeros((2, 16), np.int32),
+             "targets": np.zeros((2, 16), np.int32)}
+    opt = optax.sgd(1e-2)
+    tr = Trainer(GPT2(cfg), opt, token_cross_entropy_loss,
+                 mesh=local_mesh(1), checkpoint_dir=str(tmp_path),
+                 log_every=10**9)
+    tr.init(batch)
+    # adopt the imported weights, save at step 0
+    tr.state = TrainState(step=tr.state.step,
+                          params=jax.device_put(params),
+                          opt_state=tr.state.opt_state)
+    tr._save_checkpoint(force=True)
+    tr.checkpoint.wait()
+
+    tr2 = Trainer(GPT2(cfg), opt, token_cross_entropy_loss,
+                  mesh=local_mesh(1), checkpoint_dir=str(tmp_path))
+    tr2.restore(batch)
+    tokens = np.random.default_rng(5).integers(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(torch.asarray(tokens)).logits.numpy()
+    got = GPT2(cfg).apply(tr2.state.params, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
 def test_llama_import_rejects_tied_embeddings():
     with pytest.raises(ValueError, match="tie_embeddings"):
         llama_params_from_torch(
