@@ -25,6 +25,8 @@ class ExperimentConfig:
     model_size: str = "test"       # per-family size preset
     attention: str = "dense"       # dense | pallas | ring | ulysses
     remat: bool = False
+    fused_norms: bool = False      # custom_vjp norm backward (opt-in until
+    #                                the chip A/B lands — BASELINE.md r4)
     # parallelism (mesh axis sizes; -1 = absorb remaining devices)
     strategy: str = "dp"           # dp | fsdp | tp | tp_fsdp | auto
     device_memory_gb: float = 0.0  # per-chip HBM for --strategy auto
@@ -196,6 +198,7 @@ def _build_model(cfg: ExperimentConfig):
 
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
     tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
+               fused_norms=cfg.fused_norms,
                pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
                pipeline_microbatches=cfg.pipeline_microbatches,
                pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts,
