@@ -115,7 +115,11 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
     kw = dict(vocab_size=32000, max_seq_len=4096, causal=True,
               norm="rmsnorm", activation="swiglu", rope=True,
               num_kv_heads=None, use_bias=False, tie_embeddings=False,
-              norm_eps=1e-5)  # Llama's released rms_norm_eps
+              # Llama-2/3's released rms_norm_eps. Llama-1 and HF's
+              # LlamaConfig default use 1e-6 — override norm_eps to match
+              # the checkpoint when importing (torch_import validates via
+              # its rms_norm_eps kwarg).
+              norm_eps=1e-5)
     kw.update(presets[size])
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -231,9 +231,23 @@ def vit_params_from_torch(state_dict, cfg) -> dict:
     }}, tcfg)
 
 
-def llama_params_from_torch(state_dict, cfg) -> dict:
+def llama_params_from_torch(state_dict, cfg, *, rms_norm_eps=None) -> dict:
     """HF ``LlamaForCausalLM.state_dict()`` → ``{"params": ...}`` for
-    models/llama.Llama built with ``llama_config(...)``."""
+    models/llama.Llama built with ``llama_config(...)``.
+
+    ``rms_norm_eps``: the source checkpoint's ``LlamaConfig.rms_norm_eps``.
+    Pass it whenever the HF config is at hand — epsilon lives in the config,
+    not the state_dict, so a mismatch cannot be detected from weights alone:
+    our preset pins ``norm_eps=1e-5`` (Llama-2/3), but Llama-1 checkpoints
+    and HF's ``LlamaConfig`` default use 1e-6, and importing one of those
+    under the preset would silently run every RMSNorm with the wrong
+    epsilon. A mismatch with ``cfg.norm_eps`` raises; fix it with
+    ``llama_config(..., norm_eps=<checkpoint eps>)``."""
+    if rms_norm_eps is not None and rms_norm_eps != cfg.norm_eps:
+        raise ValueError(
+            f"checkpoint rms_norm_eps={rms_norm_eps} != cfg.norm_eps="
+            f"{cfg.norm_eps}; build the config with "
+            f"llama_config(..., norm_eps={rms_norm_eps})")
     if cfg.tie_embeddings:
         raise ValueError(
             "Llama import expects tie_embeddings=False (the released "

@@ -18,7 +18,7 @@ run() {  # run <label> <env...> -- <bench>
   shift
   local bench=$1
   echo "{\"capture\": \"$label\", \"at\": \"$(stamp)\"}" >> "$out"
-  if env "${envs[@]}" timeout 1800 python bench.py --bench "$bench" \
+  if env ${envs[@]+"${envs[@]}"} timeout 1800 python bench.py --bench "$bench" \
       >> "$out" 2> "/tmp/capture_${label}.err"; then
     echo "capture $label: ok"
   else

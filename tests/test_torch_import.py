@@ -87,7 +87,8 @@ def test_llama_import_matches_torch_logits(scan_layers, kv_heads):
 
     cfg = llama_config("test", dtype=jnp.float32, attention="dense",
                        scan_layers=scan_layers, num_kv_heads=kv_heads)
-    params = llama_params_from_torch(hf.state_dict(), cfg)
+    params = llama_params_from_torch(hf.state_dict(), cfg,
+                                     rms_norm_eps=hf_cfg.rms_norm_eps)
 
     rng = np.random.default_rng(1)
     tokens = rng.integers(0, 128, (2, 16))
@@ -206,3 +207,12 @@ def test_llama_import_rejects_tied_embeddings():
     with pytest.raises(ValueError, match="tie_embeddings"):
         llama_params_from_torch(
             {}, llama_config("test", tie_embeddings=True))
+
+
+def test_llama_import_rejects_eps_mismatch():
+    """A Llama-1-style checkpoint (rms_norm_eps=1e-6) must not silently
+    import under the preset's 1e-5 — epsilon lives in the HF config, not
+    the state_dict, so the importer validates it when given."""
+    with pytest.raises(ValueError, match="rms_norm_eps"):
+        llama_params_from_torch(
+            {}, llama_config("test"), rms_norm_eps=1e-6)
