@@ -28,6 +28,14 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", "tests must run on the CPU simulator"
 
+# Persistent XLA compile cache: repeat suite runs skip recompiling
+# unchanged programs (the compiled-invariant tripwires lower flagship-width
+# steps — ~30-100 s each cold, seconds warm). Keyed on the optimized HLO,
+# so a genuine program change always recompiles; /tmp scopes it to the
+# machine, not the repo.
+jax.config.update("jax_compilation_cache_dir", "/tmp/ptd_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -61,6 +69,11 @@ _QUICK = (
     "test_moe.py::test_single_expert_is_dense_mlp",
     "test_moe.py::test_moe_aux_loss_uniform_at_balance",
     "test_torch_import.py",                   # torch->TPU logit parity
+    # compiled-artifact tripwires: the structural (test-size) tier + the
+    # analytic-FLOPs pins; the flagship-width tier stays full-suite-only
+    # (CPU compiles are ~30-100 s each cold)
+    "test_compiled_invariants.py::test_structural_invariants",
+    "test_compiled_invariants.py::test_analytic_flops_formula_pinned",
 )
 
 

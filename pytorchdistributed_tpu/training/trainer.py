@@ -209,6 +209,29 @@ class Trainer:
         self._step_fn = self._build_step()
         return self.state
 
+    def lower_step(self, sample_batch, seed: int = 0):
+        """AOT-lower the jitted train step from ABSTRACT state: no params
+        are materialized and no device computation runs — only tracing.
+        Returns the `jax.stages.Lowered`; `.compile()` on it yields the
+        exact executable `train_step` would run for this (config, mesh,
+        strategy, batch shape), whose optimized HLO / memory analysis the
+        compiled-invariant tripwires assert against committed numbers
+        (tests/test_compiled_invariants.py) — the hardware-independent
+        stand-in for the reference's benchmark-as-test discipline
+        (03_model_parallel.ipynb:403-423) when no chip is reachable."""
+        abstract = self._prepare_abstract(sample_batch, jax.random.key(seed))
+        step_fn = self._build_step()
+        state_sds = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            abstract, self.state_shardings)
+        batch_sds = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct(
+                v.shape, v.dtype, sharding=self.batch_sharding(v)),
+            dict(sample_batch))
+        with jax.set_mesh(self.mesh):
+            return step_fn.lower(state_sds, batch_sds)
+
     def _prepare_abstract(self, sample_batch, rng) -> "TrainState":
         """Abstract TrainState + self.state_shardings, with NO device work:
         shared by init() (which then materializes) and restore() (which

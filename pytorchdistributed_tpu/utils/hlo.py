@@ -1,0 +1,70 @@
+"""Compiled-artifact invariants: what a train step's executable looks like.
+
+The regression tripwires the chip can't give us when the TPU tunnel is
+down (it wedged for all of rounds 3-4): instead of a throughput number,
+assert properties of the COMPILED program that predict throughput —
+per-device flops and peak temp memory from XLA's own analyses, and the
+collective-op census of the optimized (post-SPMD-partitioning) HLO. Any
+change that bloats memory, adds a collective, or changes the op mix fails
+against committed numbers in tests/test_compiled_invariants.py on the CPU
+sim, no hardware needed. This generalizes the round-4 one-off of
+byte-diffing lowered HLO between commits (BASELINE.md "Pallas kernel
+unification") into a harness; the committed-number discipline mirrors
+bench.py's COMMITTED_BASELINES. Reference analog: the benchmark-as-test
+harness at 03_model_parallel.ipynb:403-423 — this is its
+works-without-a-chip half.
+"""
+
+from __future__ import annotations
+
+import re
+
+# The full XLA collective vocabulary a step can emit. Async pairs
+# (`all-reduce-start`/`-done`) count once, as the -start; `-done` and
+# fused variants with extra suffixes are excluded by requiring `(` right
+# after the op name.
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "ragged-all-to-all",
+    "collective-broadcast",
+)
+
+
+def collective_counts(hlo_text: str) -> dict[str, int]:
+    """Census of collective ops in an HLO module's text, keyed by op name.
+
+    Run it on OPTIMIZED HLO (`compiled.as_text()`): collectives are
+    inserted by the SPMD partitioner during compilation, so pre-optimized
+    (`lowered.as_text()`) modules show shardings but few/no collectives.
+    Zero-count ops are included so equality against a committed dict also
+    catches a collective *appearing* where none was."""
+    return {
+        op: len(re.findall(rf"{op}(?:-start)?\(", hlo_text))
+        for op in COLLECTIVE_OPS
+    }
+
+
+def compiled_invariants(compiled) -> dict:
+    """The committed-invariant dict for one compiled train step.
+
+    * ``flops`` — XLA cost analysis, per device (post-partitioning).
+    * ``temp_bytes`` — peak scratch memory of the executable: the
+      activation / workspace footprint buffer assignment settled on.
+    * ``arg_bytes`` — total input size: params + optimizer state + batch.
+      The cheapest state-bloat tripwire there is (round 3's regression —
+      BN buffers riding the optimizer tree — was exactly an arg_bytes
+      growth).
+    * ``collectives`` — `collective_counts` of the optimized HLO.
+    """
+    mem = compiled.memory_analysis()
+    cost = compiled.cost_analysis() or {}
+    return {
+        "flops": float(cost.get("flops", -1.0)),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "arg_bytes": int(mem.argument_size_in_bytes),
+        "collectives": collective_counts(compiled.as_text()),
+    }

@@ -1,0 +1,47 @@
+"""Re-capture the committed compiled-artifact invariants.
+
+    python scripts/capture_invariants.py             # all configs
+    python scripts/capture_invariants.py gpt2s_2l    # a subset
+
+Prints a ready-to-paste COMMITTED dict for
+tests/test_compiled_invariants.py. Run on the same frozen image the
+suite runs on (the numbers are XLA-version-dependent by design — the
+image pins the version). Record any deliberate change in BASELINE.md.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from pytorchdistributed_tpu.utils.hlo import compiled_invariants  # noqa: E402
+from tests.test_compiled_invariants import BUILDERS  # noqa: E402
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(BUILDERS)
+    print("COMMITTED = {")
+    for name in names:
+        trainer, batch = BUILDERS[name]()
+        inv = compiled_invariants(trainer.lower_step(batch).compile())
+        print(f'    "{name}": {{')
+        print(f'        "flops": {inv["flops"]},')
+        print(f'        "temp_bytes": {inv["temp_bytes"]},')
+        print(f'        "arg_bytes": {inv["arg_bytes"]},')
+        print(f'        "collectives": {inv["collectives"]},')
+        print("    },")
+    print("}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,318 @@
+"""Hardware-independent perf tripwires (VERDICT r4 #2).
+
+Two rounds of TPU-tunnel downtime left every perf claim unverifiable on
+hardware; these tests make the *compiled artifact* the guarded surface so
+a wedged tunnel can never again blind a whole round. For each committed
+config the train step is AOT-lowered from abstract state on the 8-device
+CPU sim (`Trainer.lower_step` — no params materialized, nothing executed)
+and its executable's invariants are asserted against committed numbers:
+
+  * collective-op census of the optimized HLO (exact — a collective
+    appearing, vanishing, or changing kind is always a deliberate event);
+  * per-device flops from XLA cost analysis (exact — catches fusion /
+    partitioning changes that alter the op mix);
+  * arg bytes, exact: params + opt state + batch (r3's regression — BN
+    buffers riding the optimizer tree — was exactly this number growing);
+  * peak temp bytes (±2%: buffer assignment may legitimately wiggle with
+    compiler-internal ordering; a real activation-footprint regression is
+    far larger).
+
+Two tiers: STRUCTURAL configs (test-size widths, every parallelism
+strategy — dp / fsdp / tp x dp / 1F1B pipeline / ring / Ulysses) compile
+in seconds and run in `-m quick`; FLAGSHIP configs (bench.py's real
+widths, depth cut to 2 layers so CPU compile stays in budget — per-layer
+structure is what regresses, the committed number absorbs the depth) run
+in the full suite.
+
+When a change trips one of these ON PURPOSE (a new collective pattern, a
+deliberate memory/flops tradeoff): re-capture with
+`python scripts/capture_invariants.py [names...]`, update COMMITTED
+below, and record the why in BASELINE.md next to the bench baselines —
+same ritual as COMMITTED_BASELINES in bench.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorchdistributed_tpu.utils.hlo import compiled_invariants
+
+# ---------------------------------------------------------------------------
+# config builders: name -> (trainer, sample_batch)
+
+
+def _lm_batch(batch, seq, vocab=128):
+    rng = np.random.default_rng(0)
+    return {
+        "tokens": rng.integers(0, vocab, (batch, seq)).astype(np.int32),
+        "targets": rng.integers(0, vocab, (batch, seq)).astype(np.int32),
+    }
+
+
+def _gpt2_trainer(cfg_kw, mesh_kw, strategy, *, opt=None, loss=None):
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    return Trainer(
+        GPT2(gpt2_config(**cfg_kw)), opt or optax.adamw(3e-4),
+        loss or token_cross_entropy_loss,
+        mesh=create_mesh(**mesh_kw), strategy=strategy, log_every=10**9)
+
+
+def _structural(cfg_kw, mesh_kw, strategy):
+    cfg_kw = dict(size="test", **cfg_kw)
+    return lambda: (_gpt2_trainer(cfg_kw, mesh_kw, strategy),
+                    _lm_batch(32, 64))
+
+
+def _flagship_gpt2(size):
+    # bench_gpt2's committed config (bench.py) at depth 2: unrolled, no
+    # remat, dense attention (the CPU stand-in for the Pallas kernels),
+    # adamw, batch 8 x 1024.
+    return lambda: (_gpt2_trainer(
+        dict(size=size, num_layers=2, attention="dense", remat=False,
+             scan_layers=False),
+        dict(data=8), "dp"), _lm_batch(8, 1024, vocab=50257))
+
+
+def _flagship_llama():
+    # bench_llama1b's committed config at depth 2: adafactor, fused
+    # chunked-CE head, dots_all remat, unrolled.
+    import optax
+
+    from pytorchdistributed_tpu.models import Llama, llama_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        fused_token_cross_entropy_loss,
+    )
+
+    def build():
+        cfg = llama_config("1b", num_layers=2, max_seq_len=1024,
+                           attention="dense", remat=True,
+                           remat_policy="dots_all", scan_layers=False)
+        tr = Trainer(Llama(cfg), optax.adafactor(3e-3),
+                     fused_token_cross_entropy_loss,
+                     mesh=create_mesh(data=8), strategy="dp",
+                     log_every=10**9)
+        return tr, _lm_batch(8, 1024, vocab=32000)
+
+    return build
+
+
+def _flagship_resnet():
+    # bench_resnet50's committed config (bf16 compute, sync-BN EMA,
+    # sgd+momentum) at batch 32 instead of 256: CPU compile budget; the
+    # per-image structure (conv fusions, BN stats, the single grad
+    # all-reduce) is batch-size independent.
+    import optax
+
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.parallel import Policy
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
+
+    def build():
+        tr = Trainer(resnet50(), optax.sgd(0.1, momentum=0.9),
+                     cross_entropy_loss, mesh=create_mesh(data=8),
+                     strategy="dp", precision=Policy.bf16(),
+                     log_every=10**9)
+        rng = np.random.default_rng(0)
+        batch = {
+            "image": rng.standard_normal((32, 224, 224, 3)).astype(
+                np.float32),
+            "label": rng.integers(0, 1000, (32,)).astype(np.int32),
+        }
+        return tr, batch
+
+    return build
+
+
+BUILDERS = {
+    # tier 1: structural — every strategy's collective signature (quick)
+    "dp8": _structural({}, dict(data=8), "dp"),
+    "fsdp8": _structural({}, dict(fsdp=8), "fsdp"),
+    "tp4_dp2": _structural({}, dict(data=2, tensor=4), "tp"),
+    "pp4_1f1b": _structural(
+        dict(num_layers=4, pipeline_stages=4, pipeline_microbatches=8,
+             pp_schedule="1f1b"),
+        dict(data=2, pipe=4), "dp"),
+    "ring_seq2": _structural(dict(attention="ring"),
+                             dict(data=4, seq=2), "dp"),
+    "ulysses_seq2": _structural(dict(attention="ulysses"),
+                                dict(data=4, seq=2), "dp"),
+    # tier 2: flagship widths, depth 2 (full suite)
+    "gpt2s_2l": _flagship_gpt2("small"),
+    "gpt2m_2l": _flagship_gpt2("medium"),
+    "llama1b_2l": _flagship_llama(),
+    "resnet50_b32": _flagship_resnet(),
+}
+
+QUICK_NAMES = ("dp8", "fsdp8", "tp4_dp2", "pp4_1f1b", "ring_seq2",
+               "ulysses_seq2")
+
+# Captured by scripts/capture_invariants.py on the frozen image's
+# jax/XLA; deterministic (verified identical across cold and cache-warm
+# compiles). Update ritual in the module docstring. Notes on what the
+# numbers say: dp is ONE fused grad all-reduce (+1 for the loss mean);
+# fsdp's 9 all-gathers are the ZeRO-3 param regathers; the 1F1B pipe's
+# collective-permutes are the stage rotations; ring rotates KV 8 times
+# where Ulysses all-to-alls heads 8 times (the two CP dialects' signature
+# difference, visible right here); resnet50's ~100 all-reduces are
+# sync-BN's per-layer batch statistics (53 BNs), the TPU-native
+# SyncBatchNorm.
+COMMITTED: dict[str, dict] = {
+    "dp8": {
+        "flops": 131045120.0,
+        "temp_bytes": 8681496,
+        "arg_bytes": 1399816,
+        "collectives": {"all-reduce": 2, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "fsdp8": {
+        "flops": 147790336.0,
+        "temp_bytes": 14079520,
+        "arg_bytes": 186184,
+        "collectives": {"all-reduce": 11, "all-gather": 9,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "tp4_dp2": {
+        "flops": 142376816.0,
+        "temp_bytes": 11496920,
+        "arg_bytes": 439432,
+        "collectives": {"all-reduce": 10, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "pp4_1f1b": {
+        "flops": 89115424.0,
+        "temp_bytes": 2992960,
+        "arg_bytes": 806152,
+        "collectives": {"all-reduce": 3, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 2,
+                        "all-to-all": 3, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "ring_seq2": {
+        "flops": 118030232.0,
+        "temp_bytes": 7425056,
+        "arg_bytes": 1399816,
+        "collectives": {"all-reduce": 5, "all-gather": 3,
+                        "reduce-scatter": 0, "collective-permute": 8,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "ulysses_seq2": {
+        "flops": 120004488.0,
+        "temp_bytes": 7310272,
+        "arg_bytes": 1399816,
+        "collectives": {"all-reduce": 5, "all-gather": 3,
+                        "reduce-scatter": 0, "collective-permute": 2,
+                        "all-to-all": 8, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "gpt2s_2l": {
+        "flops": 348919955456.0,
+        "temp_bytes": 1316690288,
+        "arg_bytes": 642741256,
+        "collectives": {"all-reduce": 1, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "gpt2m_2l": {
+        "flops": 503792271360.0,
+        "temp_bytes": 1587454320,
+        "arg_bytes": 932483080,
+        "collectives": {"all-reduce": 1, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "llama1b_2l": {
+        "flops": 1350130860032.0,
+        "temp_bytes": 2828630784,
+        "arg_bytes": 1011542024,
+        "collectives": {"all-reduce": 2, "all-gather": 5,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "resnet50_b32": {
+        "flops": 105789972480.0,
+        "temp_bytes": 499951336,
+        "arg_bytes": 207077204,
+        "collectives": {"all-reduce": 100, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+}
+
+TEMP_BYTES_RTOL = 0.02
+
+
+def _check(name):
+    trainer, batch = BUILDERS[name]()
+    inv = compiled_invariants(trainer.lower_step(batch).compile())
+    want = COMMITTED[name]
+    assert inv["collectives"] == want["collectives"], (
+        f"{name}: collective census changed — deliberate? "
+        f"got {inv['collectives']}, committed {want['collectives']}")
+    assert inv["flops"] == want["flops"], (
+        f"{name}: per-device flops changed: got {inv['flops']:.6g}, "
+        f"committed {want['flops']:.6g}")
+    assert inv["arg_bytes"] == want["arg_bytes"], (
+        f"{name}: params+opt_state+batch bytes changed: got "
+        f"{inv['arg_bytes']}, committed {want['arg_bytes']} (state bloat? "
+        f"r3's BN-in-opt-tree bug was this number growing)")
+    lo = want["temp_bytes"] * (1 - TEMP_BYTES_RTOL)
+    hi = want["temp_bytes"] * (1 + TEMP_BYTES_RTOL)
+    assert lo <= inv["temp_bytes"] <= hi, (
+        f"{name}: peak temp memory moved >{TEMP_BYTES_RTOL:.0%}: got "
+        f"{inv['temp_bytes']}, committed {want['temp_bytes']}")
+
+
+@pytest.mark.parametrize("name", QUICK_NAMES)
+def test_structural_invariants(name):
+    _check(name)
+
+
+@pytest.mark.parametrize(
+    "name", [n for n in BUILDERS if n not in QUICK_NAMES])
+def test_flagship_invariants(name):
+    _check(name)
+
+
+def test_analytic_flops_formula_pinned():
+    """The MFU denominators for every headline bench claim (bench.py
+    transformer_train_flops_per_token): pin the analytic per-token flops
+    of the FULL flagship configs so the formula (or a config default)
+    can't drift silently under a reported MFU number."""
+    from bench import transformer_train_flops_per_token
+    from pytorchdistributed_tpu.models import gpt2_config, llama_config
+
+    full = {
+        "gpt2_small": gpt2_config("small"),
+        "gpt2_medium": gpt2_config("medium"),
+        "llama_1b": llama_config("1b", max_seq_len=1024),
+    }
+    got = {k: transformer_train_flops_per_token(c) for k, c in full.items()}
+    want = {
+        "gpt2_small": 797815296.0,
+        "gpt2_medium": 2271713280.0,
+        "llama_1b": 6433013760.0,
+    }
+    assert got == want, got
