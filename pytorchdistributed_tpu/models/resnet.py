@@ -31,8 +31,16 @@ from pytorchdistributed_tpu.parallel.tp import Logical
 
 
 def _conv(features, kernel, strides, cfg, name):
+    # torch_padding: torchvision's explicit symmetric (k-1)//2 per side.
+    # Identical to XLA SAME at stride 1 (odd kernels), but stride-2 convs
+    # under SAME pad one less on the low edge (stem 7x7: (2,3) vs torch's
+    # (3,3); block 3x3: (0,1) vs (1,1)) — same output shape, shifted
+    # receptive fields, so torch-imported weights only reproduce torch
+    # activations under the torch rule (see torch_import).
+    padding = (tuple(((k - 1) // 2,) * 2 for k in kernel)
+               if cfg.torch_padding else "SAME")
     return nn.Conv(
-        features, kernel, strides=strides, padding="SAME", use_bias=False,
+        features, kernel, strides=strides, padding=padding, use_bias=False,
         dtype=cfg.dtype, param_dtype=jnp.float32,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.he_normal(),
@@ -106,6 +114,11 @@ class ResNetConfig:
     dtype: object = jnp.bfloat16
     # CIFAR stem: 3x3 conv, no max-pool (for 32x32 inputs).
     cifar_stem: bool = False
+    # Pad stride-2 convs and the stem max-pool the way torch does
+    # (symmetric explicit) instead of XLA SAME. Required for exact parity
+    # with torchvision-trained weights (torch_import.py); default stays
+    # SAME — the committed bench configs were measured on it.
+    torch_padding: bool = False
 
 
 class BasicBlock(nn.Module):
@@ -165,7 +178,9 @@ class ResNet(nn.Module):
         else:
             x = _conv(cfg.width, (7, 7), (2, 2), cfg, "stem_conv")(x)
             x = nn.relu(_bn(cfg, "stem_bn", deterministic=det)(x))
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=(((1, 1), (1, 1)) if cfg.torch_padding
+                                     else "SAME"))
 
         block = BottleneckBlock if cfg.bottleneck else BasicBlock
         for stage, n_blocks in enumerate(cfg.stage_sizes):

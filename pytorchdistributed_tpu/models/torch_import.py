@@ -231,6 +231,86 @@ def vit_params_from_torch(state_dict, cfg) -> dict:
     }}, tcfg)
 
 
+def _convw(t) -> np.ndarray:
+    """torch Conv2d kernel [O, I, kh, kw] → flax NHWC kernel [kh, kw, I, O]."""
+    return _np(t).transpose(2, 3, 1, 0)
+
+
+def _bn_pair(sd, p: str) -> tuple[dict, dict]:
+    """One torch BatchNorm's tensors → (our params {scale, bias},
+    our batch_stats {mean, var}). ``num_batches_tracked`` is dropped: it
+    only feeds torch's momentum=None cumulative-average mode; our EMA is
+    momentum-based (training/trainer.py BN_EMA_MOMENTUM)."""
+    return ({"scale": _np(sd[p + "weight"]), "bias": _np(sd[p + "bias"])},
+            {"mean": _np(sd[p + "running_mean"]),
+             "var": _np(sd[p + "running_var"])})
+
+
+def resnet_params_from_torch(state_dict, cfg) -> dict:
+    """torchvision ResNet ``state_dict()`` → ``{"params": ...,
+    "batch_stats": ...}`` for models/resnet.ResNet — the migration bridge
+    for the reference's own vision model (``ModelParallelResNet50`` is
+    built from torchvision's resnet50, reference
+    03_model_parallel.ipynb:325-349 (cell 5); BASELINE config[1]).
+
+    Handles both block types (Bottleneck: resnet50-style conv1..3;
+    BasicBlock: resnet18-style conv1..2) and the downsample branch
+    (torch ``downsample.0/.1`` → our ``down_conv``/``down_bn``). Conv
+    kernels relayout NCHW→NHWC; BN ``weight/bias`` become scale/bias
+    params and ``running_mean/var`` become the "batch_stats" EMA buffers
+    torch semantics call non-parameter state — exactly how our Trainer
+    carries them (buffers outside the optimizer tree).
+
+    Requires ``cfg.torch_padding=True``: under XLA SAME the stride-2
+    convs and the stem max-pool pad asymmetrically, so torch weights in a
+    SAME model would see every spatial activation shifted — close-enough
+    logits that silently aren't the released model. Build with
+    ``resnet50(torch_padding=True)``."""
+    sd = state_dict
+    if not cfg.torch_padding:
+        raise ValueError(
+            "torch weights need torch conv padding: build the model with "
+            "resnet50(torch_padding=True) — XLA SAME pads stride-2 convs "
+            "asymmetrically and would shift every activation")
+    n_classes = _np(sd["fc.weight"]).shape[0]
+    if n_classes != cfg.num_classes:
+        raise ValueError(f"checkpoint fc has {n_classes} classes, config "
+                         f"has {cfg.num_classes}")
+    convs = ("conv1", "conv2", "conv3") if cfg.bottleneck else (
+        "conv1", "conv2")
+
+    params: dict = {}
+    stats: dict = {}
+    params["stem_conv"] = {"kernel": _convw(sd["conv1.weight"])}
+    params["stem_bn"], stats["stem_bn"] = _bn_pair(sd, "bn1.")
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            t = f"layer{stage + 1}.{b}."          # torchvision naming
+            ours = f"stage{stage + 1}_block{b}"   # models/resnet naming
+            bp: dict = {}
+            bs: dict = {}
+            for i, conv in enumerate(convs, start=1):
+                bp[conv] = {"kernel": _convw(sd[t + f"conv{i}.weight"])}
+                bp[f"bn{i}"], bs[f"bn{i}"] = _bn_pair(sd, t + f"bn{i}.")
+            if t + "downsample.0.weight" in sd:
+                bp["down_conv"] = {
+                    "kernel": _convw(sd[t + "downsample.0.weight"])}
+                bp["down_bn"], bs["down_bn"] = _bn_pair(
+                    sd, t + "downsample.1.")
+            params[ours] = bp
+            stats[ours] = bs
+    params["fc"] = {"kernel": _lin(sd, "fc.weight"),
+                    "bias": _np(sd["fc.bias"])}
+    # all-fp32 on purpose (no _finish): ResNet params/stats are fp32 with
+    # bf16 compute via cfg.dtype, matching a model-initialized tree
+    return {"params": params, "batch_stats": stats}
+
+
+def resnet50_params_from_torch(state_dict, cfg) -> dict:
+    """`resnet_params_from_torch` under the name the runbooks use."""
+    return resnet_params_from_torch(state_dict, cfg)
+
+
 def llama_params_from_torch(state_dict, cfg, *, rms_norm_eps=None) -> dict:
     """HF ``LlamaForCausalLM.state_dict()`` → ``{"params": ...}`` for
     models/llama.Llama built with ``llama_config(...)``.

@@ -209,6 +209,169 @@ def test_llama_import_rejects_tied_embeddings():
             {}, llama_config("test", tie_embeddings=True))
 
 
+def _torch_resnet50(num_classes: int = 10):
+    """A torch ResNet-50 with torchvision's exact module naming, so its
+    state_dict carries the torchvision key schema (conv1/bn1/layer{1-4}.
+    {b}.conv{1-3}/bn{1-3}/downsample.{0,1}/fc) — torchvision itself is not
+    installed in the test image. Architecture per the reference's own
+    model (ModelParallelResNet50 wraps torchvision resnet50,
+    03_model_parallel.ipynb:325-349)."""
+    tnn = torch.nn
+
+    class Bottleneck(tnn.Module):
+        def __init__(self, inplanes, planes, stride=1, downsample=None):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(inplanes, planes, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(planes)
+            self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1,
+                                    bias=False)
+            self.bn2 = tnn.BatchNorm2d(planes)
+            self.conv3 = tnn.Conv2d(planes, planes * 4, 1, bias=False)
+            self.bn3 = tnn.BatchNorm2d(planes * 4)
+            self.relu = tnn.ReLU()
+            self.downsample = downsample
+
+        def forward(self, x):
+            r = self.relu(self.bn1(self.conv1(x)))
+            r = self.relu(self.bn2(self.conv2(r)))
+            r = self.bn3(self.conv3(r))
+            if self.downsample is not None:
+                x = self.downsample(x)
+            return self.relu(x + r)
+
+    class ResNet50(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.inplanes = 64
+            self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = tnn.BatchNorm2d(64)
+            self.relu = tnn.ReLU()
+            self.maxpool = tnn.MaxPool2d(3, 2, 1)
+            self.layer1 = self._make_layer(64, 3, 1)
+            self.layer2 = self._make_layer(128, 4, 2)
+            self.layer3 = self._make_layer(256, 6, 2)
+            self.layer4 = self._make_layer(512, 3, 2)
+            self.fc = tnn.Linear(2048, num_classes)
+
+        def _make_layer(self, planes, blocks, stride):
+            down = None
+            if stride != 1 or self.inplanes != planes * 4:
+                down = tnn.Sequential(
+                    tnn.Conv2d(self.inplanes, planes * 4, 1, stride,
+                               bias=False),
+                    tnn.BatchNorm2d(planes * 4))
+            layers = [Bottleneck(self.inplanes, planes, stride, down)]
+            self.inplanes = planes * 4
+            layers += [Bottleneck(self.inplanes, planes)
+                       for _ in range(1, blocks)]
+            return tnn.Sequential(*layers)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            # torchvision's AdaptiveAvgPool2d(1) == global spatial mean
+            return self.fc(x.mean(dim=(2, 3)))
+
+    return ResNet50()
+
+
+def _warmed_torch_resnet(seed: int = 6, num_classes: int = 10):
+    """Random-init torch ResNet-50 with POPULATED BN running stats (a few
+    train-mode forwards): import must carry real running_mean/var, not the
+    0/1 init that would hide a stats-mapping bug."""
+    torch.manual_seed(seed)
+    m = _torch_resnet50(num_classes)
+    m.train()
+    with torch.no_grad():
+        for _ in range(2):
+            m(torch.randn(4, 3, 64, 64))
+    return m.eval()
+
+
+def test_resnet50_import_matches_torch_logits():
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.models.torch_import import (
+        resnet50_params_from_torch,
+    )
+
+    hf = _warmed_torch_resnet()
+    model = resnet50(num_classes=10, dtype=jnp.float32, torch_padding=True)
+    variables = resnet50_params_from_torch(hf.state_dict(), model.cfg)
+
+    rng = np.random.default_rng(6)
+    images = rng.standard_normal((2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():  # torch wants NCHW
+        want = hf(torch.asarray(images.transpose(0, 3, 1, 2))).numpy()
+    got = model.apply(jax.tree.map(jnp.asarray, variables),
+                      jnp.asarray(images), deterministic=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_resnet50_import_rejects_same_padding_config():
+    """SAME-padding models must not accept torch weights: stride-2 convs
+    pad differently, so the logits would be silently wrong."""
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.models.torch_import import (
+        resnet50_params_from_torch,
+    )
+
+    with pytest.raises(ValueError, match="torch_padding"):
+        resnet50_params_from_torch({}, resnet50(num_classes=10).cfg)
+
+
+def test_resnet50_import_rejects_class_mismatch():
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.models.torch_import import (
+        resnet50_params_from_torch,
+    )
+
+    hf = _torch_resnet50(num_classes=10)
+    cfg = resnet50(num_classes=1000, torch_padding=True).cfg
+    with pytest.raises(ValueError, match="classes"):
+        resnet50_params_from_torch(hf.state_dict(), cfg)
+
+
+def test_resnet50_imported_weights_evaluate_smoke():
+    """The migration target workload: imported torch weights riding the
+    Trainer's pad-aware evaluate(), metrics agreeing with a direct forward
+    computation of the same mean CE."""
+    import optax
+
+    from pytorchdistributed_tpu.data import DataLoader, SyntheticImageDataset
+    from pytorchdistributed_tpu.models import resnet50
+    from pytorchdistributed_tpu.models.torch_import import (
+        resnet50_params_from_torch,
+    )
+    from pytorchdistributed_tpu.runtime.mesh import local_mesh
+    from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
+    from pytorchdistributed_tpu.training.trainer import TrainState
+
+    hf = _warmed_torch_resnet()
+    model = resnet50(num_classes=10, dtype=jnp.float32, torch_padding=True)
+    variables = resnet50_params_from_torch(hf.state_dict(), model.cfg)
+
+    ds = SyntheticImageDataset(size=16, image_size=64, num_classes=10,
+                               seed=7)
+    loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0,
+                        shuffle=False)
+    batch = next(iter(loader))
+    tr = Trainer(model, optax.sgd(1e-2), cross_entropy_loss,
+                 mesh=local_mesh(1), log_every=10**9)
+    tr.init(batch)
+    tr.state = TrainState(step=tr.state.step,
+                          params=jax.device_put(variables),
+                          opt_state=tr.state.opt_state)
+    metrics = tr.evaluate(loader)
+
+    logits = model.apply(jax.tree.map(jnp.asarray, variables),
+                         jnp.asarray(ds.arrays["image"]),
+                         deterministic=True)
+    want_loss = float(optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.asarray(ds.arrays["label"])).mean())
+    assert abs(metrics["loss"] - want_loss) < 1e-4
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
 def test_llama_import_rejects_eps_mismatch():
     """A Llama-1-style checkpoint (rms_norm_eps=1e-6) must not silently
     import under the preset's 1e-5 — epsilon lives in the HF config, not
