@@ -33,6 +33,22 @@ the env contract and re-shard their data accordingly; note the Trainer's
 mid-epoch resume geometry guard refuses to fast-forward across a
 world-size change (resume restarts the epoch boundary from the
 checkpoint instead).
+
+A shrunken group does not stay shrunken for the life of the job
+(torchrun's max bound is standing, not a ratchet): a charged relaunch
+boundary after a shrink probes one worker BIGGER again, back toward the
+original ``--nproc-per-node`` — but only when the incarnation that just
+failed had first run HEALTHY for ``--elastic-regrow-after`` seconds.
+The uptime gate is what separates "stable group hit an independent
+transient, worth probing for returned capacity" from "still failing
+fast, the shrink evidence is not done accumulating": without it a
+probe on every restart would reset the consecutive-failure tracker
+before it ever reached two, making sizes below max−1 unreachable for a
+persistently bad slot. Probes ride restarts the group was paying for
+anyway, so flapping is bounded by the ``--max-restarts`` budget. There
+is no external "node joined" signal on a single-host agent (torchrun
+regrows on rendezvous arrivals), so a stable-then-interrupted relaunch
+boundary is the honest stand-in.
 """
 
 from __future__ import annotations
@@ -121,8 +137,16 @@ def main(argv=None) -> int:
     parser.add_argument("--elastic-min-nproc", type=int, default=0,
                         help="allow the group to relaunch SMALLER (down to "
                              "this size) when the same rank fails twice in "
-                             "a row — torchrun --nnodes=min:max resize "
-                             "semantics (0 = fixed size)")
+                             "a row, and to probe back BIGGER (up to "
+                             "--nproc-per-node) on later restarts — "
+                             "torchrun --nnodes=min:max resize semantics "
+                             "(0 = fixed size)")
+    parser.add_argument("--elastic-regrow-after", type=float, default=30.0,
+                        help="minimum healthy uptime (s) of the failing "
+                             "incarnation before a restart also probes the "
+                             "group one worker bigger; failures earlier "
+                             "than this are treated as continuing "
+                             "instability and never regrow")
     parser.add_argument("script", help="training script to run")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -198,7 +222,31 @@ def main(argv=None) -> int:
                                     now=time.time(), baseline=spawned_at)
                 failed = sorted(set(r for r in stale if codes[r] is None)
                                 | set(exited))
+        # Snapshot BEFORE the teardown: _kill_group can block ~10s on a
+        # SIGTERM-ignoring worker, and that wait is not health either.
+        detected_at = time.time()
         _kill_group(procs)
+        # Healthy uptime of the incarnation that just failed (feeds the
+        # regrow gate below). Clean exits: wall clock to detection —
+        # lag is ~monitor-interval + the settle window. HUNG cohorts:
+        # detection latency (heartbeat grace/timeout, minutes by default)
+        # is NOT health — credit the cohort only up to its last observed
+        # beat, 0 if it never beat; otherwise a slot that persistently
+        # WEDGES would pass the gate on pure detection lag and
+        # regrow-flapping would defeat the shrink tracker (the exact
+        # pathology the gate exists to prevent).
+        if why == "failed":
+            healthy_for = detected_at - spawned_at
+        else:
+            beats = []
+            for r in failed:
+                try:
+                    beats.append(os.path.getmtime(
+                        os.path.join(hb_dir, f"rank{r}")))
+                except OSError:
+                    pass
+            healthy_for = max(0.0, max(beats, default=spawned_at)
+                              - spawned_at)
         if hb_dir is not None:  # each incarnation gets a fresh dir
             shutil.rmtree(hb_dir, ignore_errors=True)
         failed_rank = failed[0]
@@ -225,6 +273,19 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
         restarts += 1
+        if (args.elastic_min_nproc > 0 and nproc < args.nproc_per_node
+                and healthy_for >= args.elastic_regrow_after):
+            # regrow probe: the shrunken group ran healthy long enough
+            # that this failure reads as an independent transient, and the
+            # boundary tears the group down anyway — readmit one worker
+            # toward the original size. Fast failures never reach here
+            # (uptime gate), so shrink evidence for a still-bad slot keeps
+            # accumulating instead of being reset by probes; flapping is
+            # bounded because probes only ride charged restarts.
+            nproc += 1
+            last_failed, consecutive = None, 0
+            print(f"[run] regrowing group to {nproc} (elastic probe "
+                  f"toward {args.nproc_per_node})", file=sys.stderr)
         print(f"[run] rank {failed_rank} {why}; restart "
               f"{restarts}/{args.max_restarts}", file=sys.stderr)
 
