@@ -211,13 +211,11 @@ def vit_params_from_torch(state_dict, cfg) -> dict:
             f"checkpoint has {pos.shape[0]} patch positions but the config "
             f"({cfg.image_size}px / {cfg.patch_size}px patches) needs "
             f"{cfg.num_patches + 1}")
-    # torch Conv2d kernel [E, C, P, P] → flax NHWC conv kernel [P, P, C, E]
-    patch_w = _np(sd[emb + "patch_embeddings.projection.weight"]
-                  ).transpose(2, 3, 1, 0)
     return _finish({"params": {
         "embed": {
             "patch_embed": {
-                "kernel": patch_w,
+                "kernel": _convw(
+                    sd[emb + "patch_embeddings.projection.weight"]),
                 "bias": _np(sd[emb + "patch_embeddings.projection.bias"])},
             "cls": _np(sd[emb + "cls_token"]),            # [1, 1, E]
             "pos_embed": pos,
