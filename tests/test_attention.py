@@ -256,6 +256,35 @@ def test_flash_tpu_lowering_smoke():
         np.asarray(g)).all()
 
 
+def test_ring_check_vma_tpu():
+    """shard_map's one static safety check, ON, for the framework's most
+    intricate collective (VERDICT r4 #8): the production opt-out
+    (check_vma=False) exists for Pallas-interpret false positives on the
+    CPU sim, so when real hardware is attached, run a checked fwd+bwd ring
+    step compiled (interpret=False) and require the checker to accept it.
+    A single chip gives a size-1 seq axis — the vma check is a trace-time
+    property of the collective program (axis names, not sizes), so the
+    evidence transfers; a multi-chip run would use the same call."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a real TPU (suite runs on the CPU sim)")
+    n = len(jax.devices())
+    seq = 2 if n % 2 == 0 else 1
+    data = n // seq if seq > 1 else n
+    mesh = create_mesh(data=data, seq=seq)
+    rng = np.random.default_rng(5)
+    # batch = the data-axis size so the shard_map divides on any host
+    # (1-chip bench rig through v4-8/v5e-8 pods)
+    q, k, v = (jnp.asarray(rng.standard_normal((max(data, 2), 256, 4, 64)),
+                           jnp.float32) for _ in range(3))
+    kw = dict(causal=True, interpret=False, check_vma=True)
+    with jax.set_mesh(mesh):
+        out = ring_attention_sharded(q, k, v, **kw)
+        g = jax.grad(lambda q: ring_attention_sharded(
+            q, k, v, **kw).sum())(q)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_ring_kernels_tpu_lowering_smoke():
     """Mosaic-lowering check for the ring-attention block kernels (the
     suite's CPU sim runs them in interpret mode, which hides TPU tiling
