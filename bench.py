@@ -240,6 +240,93 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     return result
 
 
+def bench_bert(size: str = "base", batch_size: int = 64,
+               seq_len: int = 128) -> dict:
+    """BERT-base MLM pretraining throughput (BASELINE config[2]: "BERT-base
+    MLM (DDP + amp → bf16)"), single chip: released post-LN/exact-GELU
+    architecture (the r4 fidelity pins), dynamic RoBERTa-style masking via
+    MLMDataset, bf16 compute, adamw. Samples/s is the BASELINE.json
+    headline metric; MFU rides along from the analytic formula (the
+    masked-LM head reuses the tied embedding — same vocab matmul the
+    formula counts). seq 128 is BERT's phase-1 pretraining shape: the
+    768-wide matmul story matches GPT-2-small, so expect the same MFU
+    neighborhood."""
+    import optax
+
+    from pytorchdistributed_tpu.data import MLMDataset, SyntheticTokenDataset
+    from pytorchdistributed_tpu.models import BertMLM, bert_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    import jax
+    attention = "pallas" if jax.default_backend() == "tpu" else "dense"
+    cfg = bert_config(size, max_seq_len=seq_len, attention=attention,
+                      remat=False, scan_layers=False,
+                      fused_norms=_fused_norms_override())
+    trainer = Trainer(BertMLM(cfg), optax.adamw(1e-4),
+                      token_cross_entropy_loss, mesh=create_mesh(),
+                      strategy="dp", log_every=10**9)
+    ds = MLMDataset(
+        SyntheticTokenDataset(size=batch_size, seq_len=seq_len,
+                              vocab_size=cfg.vocab_size, seed=0),
+        vocab_size=cfg.vocab_size, seed=0)
+    batch = ds[np.arange(batch_size)]
+    sec = _time_steps(trainer, batch, steps=10)
+    tag = {"base": "bert_base", "large": "bert_large"}.get(
+        size, f"bert_{size}")
+    result = {"metric": f"{tag}_mlm_samples_per_s",
+              "value": round(batch_size / sec, 1), "unit": "samples/s",
+              "tokens_per_s": round(batch_size * seq_len / sec, 1)}
+    mfu = _mfu(transformer_train_flops_per_token(cfg)
+               * batch_size * seq_len, sec)
+    if mfu is not None:
+        result["mfu"] = mfu
+    return result
+
+
+def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
+    """ViT-L/16 training throughput (BASELINE config[4]'s model on one
+    chip; the pod run adds DCN data parallelism around the same step).
+    bf16 compute, adamw, 224px/16px patches → seq 197. Attention is dense
+    on purpose even on TPU: at seq 197 attention is ~2% of model FLOPs
+    and the odd length sits badly in the flash kernels' block tiling.
+    MFU uses the analytic transformer formula on the encoder (the patch
+    embedding ≈ one extra 768-wide matmul and the 1000-class head are
+    inside ~3% — the encoder dominates)."""
+    import optax
+
+    from pytorchdistributed_tpu.models import ViT, vit_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
+
+    cfg = vit_config(size, attention="dense", remat=False,
+                     scan_layers=False,
+                     fused_norms=_fused_norms_override())
+    trainer = Trainer(ViT(cfg), optax.adamw(3e-4), cross_entropy_loss,
+                      mesh=create_mesh(), strategy="dp", log_every=10**9)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.standard_normal(
+            (batch_size, cfg.image_size, cfg.image_size, 3)).astype(
+                np.float32),
+        "label": rng.integers(0, cfg.num_classes, (batch_size,)).astype(
+            np.int32),
+    }
+    sec = _time_steps(trainer, batch, steps=10)
+    seq = cfg.num_patches + 1
+    tag = {"large": "vit_l16"}.get(size, f"vit_{size}_p16")
+    result = {"metric": f"{tag}_train_img_per_s",
+              "value": round(batch_size / sec, 1), "unit": "img/s"}
+    mfu = _mfu(transformer_train_flops_per_token(cfg.transformer)
+               * batch_size * seq, sec)
+    if mfu is not None:
+        result["mfu"] = mfu
+    return result
+
+
 def bench_resnet50() -> dict:
     import optax
 
@@ -652,6 +739,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "longcontext": functools.partial(
                bench_llama1b, batch_size=2, seq_len=4096,
                metric="llama1b_s4096_train_tokens_per_s"),
+           "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
