@@ -20,9 +20,15 @@ and the second incarnation's ``fit(resume=True)`` restores the latest
 sharded checkpoint and fast-forwards past the already-trained batches —
 the run finishes with the same loss an uninterrupted job produces
 (asserted exactly in tests/test_launch.py::
-test_elastic_restart_resumes_real_training). Add --elastic-min-nproc 1
-to see capacity-reduction resize instead of same-size restart when a
-rank fails persistently.
+test_elastic_restart_resumes_real_training). The demo is one-shot per
+checkpoint_dir: the died-once marker and the finished checkpoint both
+live there, so a second identical invocation injects no fault and
+resumes a completed run — `rm -rf` the directory to replay it (the
+script prints a reminder). Capacity-reduction resize
+(--elastic-min-nproc) needs a PERSISTENTLY failing rank and a
+world-size-independent data shard, which this one-shot script doesn't
+stage — see tests/test_launch.py::test_elastic_resize_* for that
+workflow.
 """
 
 import argparse
@@ -64,6 +70,11 @@ def main():
         loader = DataLoader(dataset, batch_size=args.batch_size)
 
         died_marker = os.path.join(args.checkpoint_dir, "died_once")
+        if (args.die_at_step and ptd.get_rank() == 0
+                and os.path.exists(died_marker)):
+            print(f"[rank 0] marker {died_marker} present: fault injection "
+                  f"off (rm -rf {args.checkpoint_dir} to replay the demo)",
+                  flush=True)
         if args.die_at_step and ptd.get_rank() == 0 \
                 and not os.path.exists(died_marker):
             # fault injection: wrap the loader so rank 0's first life ends

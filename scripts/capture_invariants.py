@@ -25,15 +25,21 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from pytorchdistributed_tpu.utils.hlo import compiled_invariants  # noqa: E402
-from tests.test_compiled_invariants import BUILDERS  # noqa: E402
+from tests.test_compiled_invariants import (  # noqa: E402
+    BUILDERS,
+    decode_lowered,
+)
 
 
 def main() -> None:
-    names = sys.argv[1:] or list(BUILDERS)
+    names = sys.argv[1:] or list(BUILDERS) + ["decode"]
     print("COMMITTED = {")
     for name in names:
-        trainer, batch = BUILDERS[name]()
-        inv = compiled_invariants(trainer.lower_step(batch).compile())
+        if name == "decode":  # the serving-path pin (DECODE_COMMITTED)
+            inv = compiled_invariants(decode_lowered().compile())
+        else:
+            trainer, batch = BUILDERS[name]()
+            inv = compiled_invariants(trainer.lower_step(batch).compile())
         print(f'    "{name}": {{')
         print(f'        "flops": {inv["flops"]},')
         print(f'        "temp_bytes": {inv["temp_bytes"]},')

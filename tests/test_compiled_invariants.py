@@ -72,6 +72,23 @@ def _structural(cfg_kw, mesh_kw, strategy):
                     _lm_batch(32, 64))
 
 
+def _moe_structural():
+    # Switch-MoE over the expert axis: the one strategy signature the
+    # other structural configs miss (one-hot dispatch lowering to
+    # all_to_all; the aux loss rides the "losses" collection)
+    def build():
+        from pytorchdistributed_tpu.training import (
+            moe_token_cross_entropy_loss,
+        )
+
+        return (_gpt2_trainer(dict(size="test", moe_experts=4),
+                              dict(data=2, expert=4), "tp",
+                              loss=moe_token_cross_entropy_loss),
+                _lm_batch(32, 64))
+
+    return build
+
+
 def _flagship_gpt2(size):
     # bench_gpt2's committed config (bench.py) at depth 2: unrolled, no
     # remat, dense attention (the CPU stand-in for the Pallas kernels),
@@ -148,6 +165,7 @@ BUILDERS = {
                              dict(data=4, seq=2), "dp"),
     "ulysses_seq2": _structural(dict(attention="ulysses"),
                                 dict(data=4, seq=2), "dp"),
+    "moe_ep4": _moe_structural(),
     # tier 2: flagship widths, depth 2 (full suite)
     "gpt2s_2l": _flagship_gpt2("small"),
     "gpt2m_2l": _flagship_gpt2("medium"),
@@ -156,7 +174,7 @@ BUILDERS = {
 }
 
 QUICK_NAMES = ("dp8", "fsdp8", "tp4_dp2", "pp4_1f1b", "ring_seq2",
-               "ulysses_seq2")
+               "ulysses_seq2", "moe_ep4")
 
 # Captured by scripts/capture_invariants.py on the frozen image's
 # jax/XLA; deterministic (verified identical across cold and cache-warm
@@ -259,15 +277,25 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
+    # NOTE the zero all-to-all: at these shapes XLA partitions the
+    # one-hot dispatch einsums into all-gather + all-reduce rather than a
+    # literal all-to-all — the census records what the compiler actually
+    # emits, which is exactly why it's worth pinning.
+    "moe_ep4": {
+        "flops": 851241152.0,
+        "temp_bytes": 47304472,
+        "arg_bytes": 1399816,
+        "collectives": {"all-reduce": 12, "all-gather": 3,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
 }
 
 TEMP_BYTES_RTOL = 0.02
 
 
-def _check(name):
-    trainer, batch = BUILDERS[name]()
-    inv = compiled_invariants(trainer.lower_step(batch).compile())
-    want = COMMITTED[name]
+def _assert_invariants(name, inv, want):
     assert inv["collectives"] == want["collectives"], (
         f"{name}: collective census changed — deliberate? "
         f"got {inv['collectives']}, committed {want['collectives']}")
@@ -285,6 +313,12 @@ def _check(name):
         f"{inv['temp_bytes']}, committed {want['temp_bytes']}")
 
 
+def _check(name):
+    trainer, batch = BUILDERS[name]()
+    inv = compiled_invariants(trainer.lower_step(batch).compile())
+    _assert_invariants(name, inv, COMMITTED[name])
+
+
 @pytest.mark.parametrize("name", QUICK_NAMES)
 def test_structural_invariants(name):
     _check(name)
@@ -294,6 +328,52 @@ def test_structural_invariants(name):
     "name", [n for n in BUILDERS if n not in QUICK_NAMES])
 def test_flagship_invariants(name):
     _check(name)
+
+
+DECODE_COMMITTED: dict = {
+    "flops": 226508308480.0,
+    "temp_bytes": 811830472,
+    "arg_bytes": 214252552,
+    "collectives": {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
+                    "collective-permute": 0, "all-to-all": 0,
+                    "ragged-all-to-all": 0, "collective-broadcast": 0},
+}
+
+
+def decode_lowered():
+    """Lower the full generate() program — chunked prefill + 128-tick
+    lax.scan with KV cache, bench_generate's exact shape at depth 2.
+    Shared by test_decode_invariants and scripts/capture_invariants.py
+    (the recapture ritual covers "decode" by name)."""
+    import dataclasses
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.inference import generate
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+
+    cfg = gpt2_config("small", num_layers=2, scan_layers=False)
+    model = GPT2(cfg)
+    boxed = jax.eval_shape(model.init, jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    params_sds = nn.meta.unbox(boxed)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    prompt_sds = jax.ShapeDtypeStruct((4, 512), jnp.int32)
+    # the prng key is concrete (tiny); params/prompt stay abstract
+    return generate.lower(dm, params_sds, prompt_sds, max_new_tokens=128,
+                          temperature=0.8, top_k=40, rng=jax.random.key(1))
+
+
+def test_decode_invariants():
+    """The serving path's tripwire: the committed decode headline
+    (gpt2s_decode_tokens_per_s, bench.py bench_generate) had no
+    hardware-independent guard. Decode is single-chip (the bench's
+    committed point), so the collective census should stay all-zero;
+    temp bytes bound the KV-cache + scan working set."""
+    inv = compiled_invariants(decode_lowered().compile())
+    _assert_invariants("decode", inv, DECODE_COMMITTED)
 
 
 def test_analytic_flops_formula_pinned():
