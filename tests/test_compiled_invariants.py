@@ -259,11 +259,16 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
+    # Re-pinned after the r5 fused-CE seq-chunking fix (BASELINE.md): the
+    # original capture showed 5 all-gathers + 1.35e12 per-device flops —
+    # the batch-axis-sliced CE chunks were making the partitioner gather
+    # neighbors' hidden states and redundantly compute their CE rows.
+    # Chunking seq instead: zero all-gathers, 30% fewer per-device flops.
     "llama1b_2l": {
-        "flops": 1350130860032.0,
-        "temp_bytes": 2828630784,
+        "flops": 947261276160.0,
+        "temp_bytes": 2622011976,
         "arg_bytes": 1011542024,
-        "collectives": {"all-reduce": 2, "all-gather": 5,
+        "collectives": {"all-reduce": 2, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
