@@ -89,14 +89,16 @@ def _moe_structural():
     return build
 
 
-def _flagship_gpt2(size):
+def _flagship_gpt2(size, mesh_kw=None, strategy="dp", **extra):
     # bench_gpt2's committed config (bench.py) at depth 2: unrolled, no
     # remat, dense attention (the CPU stand-in for the Pallas kernels),
-    # adamw, batch 8 x 1024.
-    return lambda: (_gpt2_trainer(
-        dict(size=size, num_layers=2, attention="dense", remat=False,
-             scan_layers=False),
-        dict(data=8), "dp"), _lm_batch(8, 1024, vocab=50257))
+    # adamw, batch 8 x 1024. mesh_kw/strategy/extra let the fsdp variant
+    # reuse the same recipe.
+    cfg = dict(size=size, num_layers=2, attention="dense", remat=False,
+               scan_layers=False)
+    cfg.update(extra)
+    return lambda: (_gpt2_trainer(cfg, mesh_kw or dict(data=8), strategy),
+                    _lm_batch(8, 1024, vocab=50257))
 
 
 def _flagship_llama():
@@ -169,6 +171,13 @@ BUILDERS = {
     # tier 2: flagship widths, depth 2 (full suite)
     "gpt2s_2l": _flagship_gpt2("small"),
     "gpt2m_2l": _flagship_gpt2("medium"),
+    # BASELINE config[3]'s actual recipe at depth 2: medium + ZeRO-3 +
+    # activation checkpointing. The structural fsdp config is test-width,
+    # where min_weight_size leaves most params replicated — only real
+    # widths exercise the real shard/gather structure (the fused-CE bug
+    # was invisible at test width for the same reason).
+    "gpt2m_2l_fsdp8": _flagship_gpt2("medium", mesh_kw=dict(fsdp=8),
+                                     strategy="fsdp", remat=True),
     "llama1b_2l": _flagship_llama(),
     "resnet50_b32": _flagship_resnet(),
 }
@@ -255,6 +264,25 @@ COMMITTED: dict[str, dict] = {
         "temp_bytes": 1587454320,
         "arg_bytes": 932483080,
         "collectives": {"all-reduce": 1, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    # Census caveat, verified with a minimal probe: XLA:CPU lowers the
+    # canonical grad reduce-scatter pattern (contraction over the sharded
+    # batch, output sharded like the param) as all-reduce + slice — it
+    # never emits reduce-scatter ops. So fsdp rows legitimately show
+    # reduce-scatter 0 here; on TPU the same programs get the
+    # ReduceScatterCreator pass. The CPU census is still a valid tripwire
+    # (a change in the all-reduce/all-gather counts is a change in the
+    # program), just not a bandwidth model of the TPU lowering. The
+    # ~6 GB temp here is likewise CPU-inflated: full all-reduced grads
+    # live before slicing.
+    "gpt2m_2l_fsdp8": {
+        "flops": 513154646016.0,
+        "temp_bytes": 5980155704,
+        "arg_bytes": 116718088,
+        "collectives": {"all-reduce": 19, "all-gather": 15,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
