@@ -58,6 +58,12 @@ def compiled_invariants(compiled) -> dict:
       The cheapest state-bloat tripwire there is (round 3's regression —
       BN buffers riding the optimizer tree — was exactly an arg_bytes
       growth).
+    * ``alias_bytes`` — input bytes aliased to outputs: the DONATION
+      tripwire. The train step donates its TrainState; if a jit change
+      silently breaks donation (a dtype/sharding mismatch between the
+      donated input and the output is enough — jax only warns), the step
+      holds two copies of params+opt state and a model sized near HBM
+      OOMs. alias ≈ state bytes is the proof donation still holds.
     * ``collectives`` — `collective_counts` of the optimized HLO.
     """
     mem = compiled.memory_analysis()
@@ -66,5 +72,6 @@ def compiled_invariants(compiled) -> dict:
         "flops": float(cost.get("flops", -1.0)),
         "temp_bytes": int(mem.temp_size_in_bytes),
         "arg_bytes": int(mem.argument_size_in_bytes),
+        "alias_bytes": int(mem.alias_size_in_bytes),
         "collectives": collective_counts(compiled.as_text()),
     }

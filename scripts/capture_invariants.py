@@ -41,9 +41,10 @@ def main() -> None:
             trainer, batch = BUILDERS[name]()
             inv = compiled_invariants(trainer.lower_step(batch).compile())
         print(f'    "{name}": {{')
-        print(f'        "flops": {inv["flops"]},')
-        print(f'        "temp_bytes": {inv["temp_bytes"]},')
-        print(f'        "arg_bytes": {inv["arg_bytes"]},')
+        # derive the field list from the dict so a new invariant in
+        # utils/hlo.py can never be silently dropped from the paste block
+        for key in (k for k in inv if k != "collectives"):
+            print(f'        "{key}": {inv[key]},')
         print(f'        "collectives": {inv["collectives"]},')
         print("    },")
     print("}")

@@ -13,6 +13,9 @@ and its executable's invariants are asserted against committed numbers:
     partitioning changes that alter the op mix);
   * arg bytes, exact: params + opt state + batch (r3's regression — BN
     buffers riding the optimizer tree — was exactly this number growing);
+  * alias bytes, exact: the DONATION tripwire — if the train step's
+    state donation silently breaks (jax only warns), this number drops
+    and a model sized near HBM would OOM holding two state copies;
   * peak temp bytes (±2%: buffer assignment may legitimately wiggle with
     compiler-internal ordering; a real activation-footprint regression is
     far larger).
@@ -178,6 +181,12 @@ BUILDERS = {
     # was invisible at test width for the same reason).
     "gpt2m_2l_fsdp8": _flagship_gpt2("medium", mesh_kw=dict(fsdp=8),
                                      strategy="fsdp", remat=True),
+    # the fused 1F1B schedule at real width (the most intricate step
+    # builder): 4 layers over 4 stages, 8 micro-batches, pipe x dp mesh
+    "gpt2s_4l_pp4": _flagship_gpt2(
+        "small", mesh_kw=dict(data=2, pipe=4), num_layers=4,
+        pipeline_stages=4, pipeline_microbatches=8, pp_schedule="1f1b",
+        scan_layers=True),  # the 1F1B stage decomposition requires it
     "llama1b_2l": _flagship_llama(),
     "resnet50_b32": _flagship_resnet(),
 }
@@ -200,6 +209,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 131045120.0,
         "temp_bytes": 8681496,
         "arg_bytes": 1399816,
+        "alias_bytes": 1397768,
         "collectives": {"all-reduce": 2, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -209,6 +219,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 147790336.0,
         "temp_bytes": 14079520,
         "arg_bytes": 186184,
+        "alias_bytes": 184136,
         "collectives": {"all-reduce": 11, "all-gather": 9,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -218,6 +229,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 142376816.0,
         "temp_bytes": 11496920,
         "arg_bytes": 439432,
+        "alias_bytes": 431240,
         "collectives": {"all-reduce": 10, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -227,6 +239,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 89115424.0,
         "temp_bytes": 2992960,
         "arg_bytes": 806152,
+        "alias_bytes": 797960,
         "collectives": {"all-reduce": 3, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 2,
                         "all-to-all": 3, "ragged-all-to-all": 0,
@@ -236,6 +249,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 118030232.0,
         "temp_bytes": 7425056,
         "arg_bytes": 1399816,
+        "alias_bytes": 1397768,
         "collectives": {"all-reduce": 5, "all-gather": 3,
                         "reduce-scatter": 0, "collective-permute": 8,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -245,15 +259,31 @@ COMMITTED: dict[str, dict] = {
         "flops": 120004488.0,
         "temp_bytes": 7310272,
         "arg_bytes": 1399816,
+        "alias_bytes": 1397768,
         "collectives": {"all-reduce": 5, "all-gather": 3,
                         "reduce-scatter": 0, "collective-permute": 2,
                         "all-to-all": 8, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    # NOTE the zero all-to-all: at these shapes XLA partitions the
+    # one-hot dispatch einsums into all-gather + all-reduce rather than a
+    # literal all-to-all — the census records what the compiler actually
+    # emits, which is exactly why it's worth pinning.
+    "moe_ep4": {
+        "flops": 851241152.0,
+        "temp_bytes": 47304472,
+        "arg_bytes": 1399816,
+        "alias_bytes": 1391624,
+        "collectives": {"all-reduce": 12, "all-gather": 3,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
     "gpt2s_2l": {
         "flops": 348919955456.0,
         "temp_bytes": 1316690288,
         "arg_bytes": 642741256,
+        "alias_bytes": 642733064,
         "collectives": {"all-reduce": 1, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -263,6 +293,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 503792271360.0,
         "temp_bytes": 1587454320,
         "arg_bytes": 932483080,
+        "alias_bytes": 932474888,
         "collectives": {"all-reduce": 1, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -282,8 +313,19 @@ COMMITTED: dict[str, dict] = {
         "flops": 513154646016.0,
         "temp_bytes": 5980155704,
         "arg_bytes": 116718088,
+        "alias_bytes": 116709896,
         "collectives": {"all-reduce": 19, "all-gather": 15,
                         "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+    },
+    "gpt2s_4l_pp4": {
+        "flops": 309091106816.0,
+        "temp_bytes": 1861801464,
+        "arg_bytes": 557711368,
+        "alias_bytes": 557678600,
+        "collectives": {"all-reduce": 27, "all-gather": 2,
+                        "reduce-scatter": 0, "collective-permute": 2,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
@@ -296,6 +338,7 @@ COMMITTED: dict[str, dict] = {
         "flops": 947261276160.0,
         "temp_bytes": 2622011976,
         "arg_bytes": 1011542024,
+        "alias_bytes": 1011533832,
         "collectives": {"all-reduce": 2, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
@@ -305,20 +348,8 @@ COMMITTED: dict[str, dict] = {
         "flops": 105789972480.0,
         "temp_bytes": 499951336,
         "arg_bytes": 207077204,
+        "alias_bytes": 204668740,
         "collectives": {"all-reduce": 100, "all-gather": 0,
-                        "reduce-scatter": 0, "collective-permute": 0,
-                        "all-to-all": 0, "ragged-all-to-all": 0,
-                        "collective-broadcast": 0},
-    },
-    # NOTE the zero all-to-all: at these shapes XLA partitions the
-    # one-hot dispatch einsums into all-gather + all-reduce rather than a
-    # literal all-to-all — the census records what the compiler actually
-    # emits, which is exactly why it's worth pinning.
-    "moe_ep4": {
-        "flops": 851241152.0,
-        "temp_bytes": 47304472,
-        "arg_bytes": 1399816,
-        "collectives": {"all-reduce": 12, "all-gather": 3,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
@@ -339,6 +370,11 @@ def _assert_invariants(name, inv, want):
         f"{name}: params+opt_state+batch bytes changed: got "
         f"{inv['arg_bytes']}, committed {want['arg_bytes']} (state bloat? "
         f"r3's BN-in-opt-tree bug was this number growing)")
+    assert inv["alias_bytes"] == want["alias_bytes"], (
+        f"{name}: donated/aliased bytes changed: got "
+        f"{inv['alias_bytes']}, committed {want['alias_bytes']} — if it "
+        f"DROPPED, state donation broke (jax only warns) and the step now "
+        f"holds two copies of params+opt state")
     lo = want["temp_bytes"] * (1 - TEMP_BYTES_RTOL)
     hi = want["temp_bytes"] * (1 + TEMP_BYTES_RTOL)
     assert lo <= inv["temp_bytes"] <= hi, (
@@ -367,6 +403,7 @@ DECODE_COMMITTED: dict = {
     "flops": 226508308480.0,
     "temp_bytes": 811830472,
     "arg_bytes": 214252552,
+    "alias_bytes": 0,  # generate() does not donate — no state to reuse
     "collectives": {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
                     "collective-permute": 0, "all-to-all": 0,
                     "ragged-all-to-all": 0, "collective-broadcast": 0},
