@@ -319,6 +319,11 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
+    # The 27 all-reduces decompose (audited via op_name metadata) into
+    # microbatch-shaped activation psums — the masked pipe-axis combine
+    # of the lockstep SPMD schedule — plus one per weight-grad dot; the
+    # census counts STATIC instructions, and the 1F1B while-loop executes
+    # its 2 collective-permutes once per tick.
     "gpt2s_4l_pp4": {
         "flops": 309091106816.0,
         "temp_bytes": 1861801464,
