@@ -102,3 +102,34 @@ def test_strategy_auto_end_to_end():
     assert trainer.strategy in ("fsdp", "tp_fsdp")
     batch = next(iter(loader))
     assert np.isfinite(float(trainer.train_step(batch)["loss"]))
+
+
+def test_validate_plan_compiler_verified_fit():
+    """validate_plan closes the planner's loop with XLA's own memory
+    analysis of the actual compiled step: a test model fits a generous
+    budget and fails an absurdly small one, with the reported need
+    covering at least the training state the planner counted."""
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.parallel.auto import validate_plan
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    model = GPT2(gpt2_config("test", dtype=jnp.float32))
+    tr = Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                 mesh=create_mesh(data=8), strategy="dp", log_every=10**9)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 128, (16, 32)).astype(np.int32),
+             "targets": rng.integers(0, 128, (16, 32)).astype(np.int32)}
+    ok = validate_plan(tr, batch, device_memory_bytes=2 * 2**30)
+    assert ok["fits"], ok
+    assert ok["need_bytes"] >= ok["aliased_bytes"] > 0
+    assert ok["need_bytes"] == (ok["arg_bytes"] + ok["out_bytes"]
+                                - ok["aliased_bytes"] + ok["temp_bytes"])
+    tight = validate_plan(tr, batch, device_memory_bytes=2**20)
+    assert not tight["fits"], tight
