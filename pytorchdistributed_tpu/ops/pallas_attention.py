@@ -41,6 +41,19 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _out_sds(shape, dtype, like):
+    """pallas_call out_shape typed after operand ``like``: under a
+    check_vma=True shard_map (ring_attention_sharded / ulysses_attention
+    compiled on hardware) every kernel output must declare its
+    varying-manual-axes set, and the outputs vary exactly like the
+    operands they are computed from. Outside a checked trace the aval
+    carries an empty/absent vma and this is a plain ShapeDtypeStruct."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
                 block_q: int, block_k: int, causal: bool, scale: float,
                 num_k_blocks: int, seq_len: int, carry: bool = False):
@@ -161,8 +174,8 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+            _out_sds((bh, s, d), q.dtype, q),
+            _out_sds((bh, s, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             _vmem_scratch((block_q, d)),
@@ -359,8 +372,8 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
                          lambda bkv, ki, gi, qi: (bkv, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _out_sds(k.shape, k.dtype, k),
+            _out_sds(v.shape, v.dtype, v),
         ],
         scratch_shapes=[
             _vmem_scratch((block_k, d)),
@@ -387,7 +400,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
             row_spec,
         ],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_out_sds(q.shape, q.dtype, q),
         scratch_shapes=[_vmem_scratch((block_q, d))],
         interpret=interpret,
     )(q, k, v, do, lse, delta)

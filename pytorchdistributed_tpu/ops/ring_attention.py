@@ -49,6 +49,7 @@ from pytorchdistributed_tpu.ops.pallas_attention import (
     _bwd_dkv_kernel,
     _bwd_dq_kernel,
     _fwd_kernel,
+    _out_sds,
     _vmem_scratch,
 )
 from pytorchdistributed_tpu.runtime.mesh import Axis
@@ -56,13 +57,19 @@ from pytorchdistributed_tpu.runtime.mesh import Axis
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() exact zero without
                   # generating NaNs in (m - new_m) when a row is all-masked
 
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct for a pallas_call output (``like`` fixes nothing
-    today — the enclosing shard_map runs check_vma=False, see
-    ring_attention_sharded — but keeps the call sites honest about which
-    operand the output is typed after)."""
-    del like
-    return jax.ShapeDtypeStruct(shape, dtype)
+def _vary_like(like):
+    """Promoter onto ``like``'s varying-manual-axes set (identity when the
+    trace carries no vma, i.e. check_vma=False or outside shard_map)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if not vma:
+        return lambda x: x
+    return lambda x: lax.pcast(x, tuple(vma), to="varying")
+
+
+# vma-typed pallas_call out_shapes: one definition, shared with the flash
+# kernels (pallas_attention._out_sds) — the ring's accumulators vary
+# exactly like the block operands they update.
+_sds = _out_sds
 
 
 class _RingSpec(NamedTuple):
@@ -230,9 +237,17 @@ def _ring_fwd_pass(q, k, v, spec: _RingSpec):
               else _xla_fwd_update)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    acc0 = jnp.zeros((bh, s, d), jnp.float32)
-    m0 = jnp.full((bh, s, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bh, s, 1), jnp.float32)
+    # Freshly-created accumulators are UNVARYING under check_vma=True
+    # shard_map; promote them to q's varying-manual-axes set up front —
+    # the causal lax.switch requires every branch to return identical vma,
+    # and the skip branch passes these through while the kernel branches
+    # return q-varying outputs (q varies over ALL the mesh axes its
+    # sharding touches, not just the ring axis). No-op when the checker
+    # is off (empty vma).
+    vary = _vary_like(q)
+    acc0 = vary(jnp.zeros((bh, s, d), jnp.float32))
+    m0 = vary(jnp.full((bh, s, 1), _NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((bh, s, 1), jnp.float32))
 
     def step(carry, i):
         acc, m, l, k_blk, v_blk = carry
@@ -301,8 +316,11 @@ def _ring_core_bwd(spec: _RingSpec, res, do):
               else _xla_bwd_update)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    dq0 = jnp.zeros((bh, s, d), jnp.float32)
-    dkv0 = jnp.zeros((bh, s, d), jnp.float32)
+    # see _ring_fwd_pass: promoted so the causal switch's skip branch
+    # agrees with the kernel branches under check_vma=True
+    vary = _vary_like(q)
+    dq0 = vary(jnp.zeros((bh, s, d), jnp.float32))
+    dkv0 = vary(jnp.zeros((bh, s, d), jnp.float32))
 
     def step(carry, i):
         k_blk, v_blk, dq, dk_blk, dv_blk = carry
@@ -351,7 +369,7 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
                            impl: str = "pallas", block_q: int = 512,
                            block_k: int = 512,
                            interpret: bool | None = None,
-                           check_vma: bool = False):
+                           check_vma: bool | None = None):
     """Drop-in replacement for ops.attention.dense_attention on inputs whose
     seq dim is sharded over the "seq" mesh axis (and heads optionally over
     "tensor"). Uses the ambient mesh (`jax.set_mesh`) unless given one.
@@ -359,14 +377,15 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
     ``impl="pallas"`` (default) runs each visiting block through the flash
     VMEM recurrence; ``impl="xla"`` is the plain-einsum reference path.
 
-    ``check_vma``: forward shard_map's varying-manual-axes checker. OFF by
-    default because Pallas interpret mode (the CPU sim every test runs on)
-    evaluates kernels with mixed varying/invariant index constants that the
-    checker rejects ("Primitive dynamic_slice requires varying manual axes
-    to match") — a false positive the compiled TPU path does not share.
-    tests/test_attention.py::test_ring_check_vma_tpu runs a checked step on
-    real hardware (VERDICT r4 #8), so the opt-out is evidence-backed there
-    rather than hand-audited."""
+    ``check_vma``: shard_map's varying-manual-axes checker. Default (None)
+    = ON whenever the kernels run compiled (the production TPU path —
+    verified end-to-end on hardware, tests/test_attention.py::
+    test_ring_check_vma_tpu, v5e 2026-07-31) and OFF under Pallas
+    interpret mode (the CPU sim every test runs on), whose internal
+    evaluation mixes varying and invariant index constants that the
+    checker rejects ("Primitive dynamic_slice requires varying manual
+    axes to match ... please open an issue at github.com/jax-ml/jax") —
+    an interpreter limitation, not a property of this ring."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is None or not mesh.axis_names:
@@ -377,6 +396,10 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
         raise ValueError(f"unknown ring attention impl {impl!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if check_vma is None:
+        # checked by default on the compiled path; interpret mode (and the
+        # xla reference impl riding the same sim) opts out — see docstring
+        check_vma = not interpret
     spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=Axis.SEQ,
@@ -386,8 +409,6 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # default False: see the docstring — interpret mode false-positives;
-        # the TPU-gated test runs with True so the checked path has coverage
         check_vma=check_vma,
     )
     return fn(q, k, v)

@@ -58,9 +58,15 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
                       scale: float | None = None, impl: str = "pallas",
-                      interpret: bool | None = None):
+                      interpret: bool | None = None,
+                      check_vma: bool | None = None):
     """Sequence-parallel attention via head redistribution; same calling
-    convention as ring_attention_sharded."""
+    convention as ring_attention_sharded, including ``check_vma``: None =
+    checked whenever the kernels compile for real hardware, opted out
+    under Pallas interpret mode (the CPU sim), whose internals
+    false-positive the checker — see ring_attention_sharded's docstring.
+    The checked compiled path is hardware-verified alongside the ring's
+    (tests/test_attention.py::test_ulysses_check_vma_tpu)."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is None or not mesh.axis_names:
@@ -71,6 +77,8 @@ def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
         raise ValueError(f"unknown ulysses attention impl {impl!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if check_vma is None:
+        check_vma = not interpret
     spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=Axis.SEQ, causal=causal,
@@ -78,7 +86,6 @@ def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # same interpret-mode vma limitation as ring_attention_sharded
-        check_vma=False,
+        check_vma=check_vma,
     )
     return fn(q, k, v)

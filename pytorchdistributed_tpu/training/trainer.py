@@ -58,6 +58,26 @@ class TrainState(struct.PyTreeNode):
 # pass — see _split_stats.
 BN_EMA_MOMENTUM = 0.9
 
+# Default XLA compile options for the jitted steps on TPU. The TPU
+# compiler stages custom-call output tuples in its scoped-VMEM stack with
+# a per-element eligibility check but a whole-tuple, TILE-PADDED frame
+# allocation: the flash dKV backward's (dk, dv) tuple at head_dim 64
+# lane-pads 2x (64 → 128 lanes), so a long-sequence train step aborts
+# compilation at the default 16 MiB limit — measured v5e, Llama-1B at
+# S=4096: "Scoped allocation with size 17.38M and limit 16.00M exceeded
+# scoped vmem limit" (2026-07-31; chunking the kernel call does NOT help —
+# the chunks' staged outputs are concurrently live, so the frame total is
+# unchanged). 24 MiB clears the padded frame with room to spare and is
+# far under physical VMEM (~128 MiB on v5e; the conservative default
+# exists for pre-v4 chips).
+_TPU_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "24576"}
+
+
+def _default_compiler_options() -> dict[str, str] | None:
+    if jax.default_backend() != "tpu":
+        return None
+    return dict(_TPU_COMPILER_OPTIONS)
+
 
 def _split_stats(params):
     """(trainable, batch_stats-or-None). Normalization running statistics
@@ -98,7 +118,10 @@ class Trainer:
     classes: "dp" (replicated params ≙ DDP) or "fsdp" (ZeRO-3 sharding).
     ``precision=Policy.bf16()`` is the amp→bf16 port; ``remat=True`` enables
     activation checkpointing (GPipe's "time for space",
-    03_model_parallel.ipynb:637-643).
+    03_model_parallel.ipynb:637-643). ``compiler_options`` are per-step XLA
+    compile options, merged OVER the TPU backend defaults
+    (_TPU_COMPILER_OPTIONS — scoped-VMEM headroom for the flash backward
+    at long sequence); override a default by setting its key explicitly.
     """
 
     def __init__(
@@ -119,6 +142,7 @@ class Trainer:
         batch_adapter: Callable | None = None,
         accum_steps: int = 1,
         metrics_file: str | None = None,
+        compiler_options: dict[str, str] | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -129,6 +153,15 @@ class Trainer:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps
+        # User options MERGE OVER the backend defaults — a caller tuning an
+        # unrelated flag must not silently drop the scoped-VMEM fix (to
+        # override a default, set its key explicitly, e.g.
+        # {"xla_tpu_scoped_vmem_limit_kib": "16384"} restores the XLA
+        # default and with it the S=4096 compile abort).
+        defaults = _default_compiler_options() or {}
+        self._compiler_options = {**defaults, **(compiler_options or {})}
+        if not self._compiler_options:
+            self._compiler_options = None  # jit expects None, not {}
         self.log_every = log_every
         from pytorchdistributed_tpu.parallel.tp import logical_rules
         self._rules = logical_rules(strategy)
@@ -204,7 +237,8 @@ class Trainer:
         self._prepare_abstract(sample_batch, rng)
         with jax.set_mesh(self.mesh):
             self.state = jax.jit(
-                make_state, out_shardings=self.state_shardings
+                make_state, out_shardings=self.state_shardings,
+                compiler_options=self._compiler_options,
             )(rng, sample_batch)
         self._step_fn = self._build_step()
         return self.state
@@ -427,6 +461,7 @@ class Trainer:
             in_shardings=(self.state_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
+            compiler_options=self._compiler_options,
         )
 
     def _build_1f1b_step(self):
@@ -535,6 +570,7 @@ class Trainer:
             in_shardings=(self.state_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
+            compiler_options=self._compiler_options,
         )
 
     def train_step(self, batch) -> dict[str, float]:
@@ -635,7 +671,8 @@ class Trainer:
             # mismatched-layout batch errors instead of silently re-laying
             # out (params side reuses the state shardings).
             self._eval_fn = jax.jit(
-                estep, in_shardings=(self.state_shardings.params, None))
+                estep, in_shardings=(self.state_shardings.params, None),
+                compiler_options=self._compiler_options)
         if any(not isinstance(v, jax.Array) for v in batch.values()):
             batch = shard_batch(batch, self.batch_sharding)
         with jax.set_mesh(self.mesh):

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Summarize a capture JSONL (scripts/capture_r*.sh output) as a table.
+
+Interleaves of ``{"capture": label, "at": ...}`` stamps and bench.py result
+lines are folded into one row per capture: label, metric, value,
+vs_baseline, and the r3 builder-reported claim it verifies (BASELINE.md
+"Recorded absolute numbers"), so the verified-or-corrected call in the
+runbook (BASELINE.md "Tunnel-return capture runbook" step 1) is one read.
+
+    python scripts/summarize_capture.py BENCH_r05_local.jsonl
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+# r3 builder-reported claims under verification (BASELINE.md tables).
+R3_CLAIMS = {
+    "gpt2s_train_tokens_per_s": 119623.4,
+    "gpt2m_train_tokens_per_s": 46035.7,
+    "llama1b_train_tokens_per_s": 18449.3,
+    "resnet50_train_img_per_s": 2256.2,
+    "gpt2s_decode_tokens_per_s": 3833.0,
+}
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_r05_local.jsonl"
+    label = "?"
+    rows = []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "capture" in rec and "at" in rec and "metric" not in rec:
+            label = rec["capture"]
+        elif rec.get("failed"):
+            rows.append((label, "FAILED rc=%s" % rec.get("rc"), "", "", ""))
+        elif "metric" in rec:
+            claim = R3_CLAIMS.get(rec["metric"])
+            delta = ("%+.1f%%" % (100 * (rec["value"] / claim - 1))
+                     if claim else "")
+            rows.append((label, rec["metric"], "%.1f" % rec["value"],
+                         str(rec.get("vs_baseline", "")), delta))
+        elif "passed" in rec:
+            rows.append((label, "passed=%s" % rec["passed"], "", "", ""))
+    w = [max(len(r[i]) for r in rows + [("label", "metric", "value",
+                                         "vs_base", "vs_r3claim")])
+         for i in range(5)]
+    hdr = ("label", "metric", "value", "vs_base", "vs_r3claim")
+    for r in [hdr] + rows:
+        print("  ".join(str(c).ljust(w[i]) for i, c in enumerate(r)))
+
+
+if __name__ == "__main__":
+    main()

@@ -258,10 +258,14 @@ def test_flash_tpu_lowering_smoke():
 
 def test_ring_check_vma_tpu():
     """shard_map's one static safety check, ON, for the framework's most
-    intricate collective (VERDICT r4 #8): the production opt-out
-    (check_vma=False) exists for Pallas-interpret false positives on the
-    CPU sim, so when real hardware is attached, run a checked fwd+bwd ring
-    step compiled (interpret=False) and require the checker to accept it.
+    intricate collective (VERDICT r4 #8). Since r5 this guards the
+    PRODUCTION DEFAULT: ring_attention_sharded runs check_vma=True
+    whenever the kernels compile for real hardware, opting out only under
+    Pallas interpret mode (CPU sim), whose internal evaluation
+    false-positives the checker. When hardware is attached, run a checked
+    fwd+bwd ring step compiled (interpret=False) and require the checker
+    to accept it — the explicit check_vma=True below pins the checked
+    path even if the default ever regresses.
     A single chip gives a size-1 seq axis — the vma check is a trace-time
     property of the collective program (axis names, not sizes), so the
     evidence transfers; a multi-chip run would use the same call."""
@@ -280,6 +284,29 @@ def test_ring_check_vma_tpu():
     with jax.set_mesh(mesh):
         out = ring_attention_sharded(q, k, v, **kw)
         g = jax.grad(lambda q: ring_attention_sharded(
+            q, k, v, **kw).sum())(q)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ulysses_check_vma_tpu():
+    """Ulysses rides the same checked-by-default contract as the ring
+    (check_vma = not interpret): run a checked fwd+bwd all-to-all step
+    compiled on real hardware and require the checker to accept it."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a real TPU (suite runs on the CPU sim)")
+    n = len(jax.devices())
+    seq = 2 if n % 2 == 0 else 1
+    data = n // seq if seq > 1 else n
+    mesh = create_mesh(data=data, seq=seq)
+    rng = np.random.default_rng(6)
+    # heads must divide the seq axis for the all-to-all redistribution
+    q, k, v = (jnp.asarray(rng.standard_normal((max(data, 2), 256, 4, 64)),
+                           jnp.float32) for _ in range(3))
+    kw = dict(causal=True, interpret=False, check_vma=True)
+    with jax.set_mesh(mesh):
+        out = ulysses_attention(q, k, v, **kw)
+        g = jax.grad(lambda q: ulysses_attention(
             q, k, v, **kw).sum())(q)
     assert np.isfinite(np.asarray(out)).all()
     assert np.isfinite(np.asarray(g)).all()
