@@ -202,11 +202,24 @@ def bench_gpt2(size: str = "small") -> dict:
     # small AND medium at batch 8 fit v5e HBM without recompute (medium:
     # 47.4% MFU, the 1024-wide-matmul shape dividend over small's 45.9%).
     # remat="dots" is the fallback for bigger models/batches (config.py).
+    import os
+    attn_block = os.environ.get("PTD_ATTN_BLOCK")
     cfg = gpt2_config(size, attention=attention, remat=False,
                       scan_layers=False,
+                      ce_chunk=int(os.environ.get("PTD_CE_CHUNK", 2048)),
+                      attn_block=int(attn_block) if attn_block else None,
                       fused_norms=_fused_norms_override())
     model = GPT2(cfg)
-    trainer = Trainer(model, optax.adamw(3e-4), token_cross_entropy_loss,
+    # r2 measured dense CE faster than the fused chunked head for SMALL at
+    # batch 8 (BASELINE.md r2-late note); PTD_FUSED_CE=1 re-opens the A/B
+    # (medium's 1.6 GB fp32 logits round-trip is 4x small's relative cost)
+    if os.environ.get("PTD_FUSED_CE") == "1":
+        from pytorchdistributed_tpu.training import (
+            fused_token_cross_entropy_loss as loss_fn,
+        )
+    else:
+        loss_fn = token_cross_entropy_loss
+    trainer = Trainer(model, optax.adamw(3e-4), loss_fn,
                       mesh=create_mesh(), strategy="dp", log_every=10**9)
     rng = np.random.default_rng(0)
     batch = {
@@ -220,7 +233,12 @@ def bench_gpt2(size: str = "small") -> dict:
     tag = {"small": "gpt2s", "medium": "gpt2m"}.get(size, f"gpt2_{size}")
     result = {"metric": f"{tag}_train_tokens_per_s",
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
-    _stamp_overrides(result)
+    # PTD_CE_CHUNK only does anything here under the fused head — stamping
+    # it on the dense-CE path would taint a committed-config record
+    keys = ("PTD_FUSED_CE", "PTD_ATTN_BLOCK", "PTD_FUSED_NORMS")
+    if os.environ.get("PTD_FUSED_CE") == "1":
+        keys += ("PTD_CE_CHUNK",)
+    _stamp_overrides(result, keys)
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu
@@ -258,9 +276,10 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     # measured-fastest and stays the default
     batch_size = int(os.environ.get("PTD_BENCH_BS", batch_size))
     remat_policy = os.environ.get("PTD_REMAT_POLICY", "dots_all")
+    ce_chunk = int(os.environ.get("PTD_CE_CHUNK", 2048))
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
                        remat=True, remat_policy=remat_policy,
-                       scan_layers=False,
+                       scan_layers=False, ce_chunk=ce_chunk,
                        fused_norms=_fused_norms_override())
     trainer = Trainer(Llama(cfg), optax.adafactor(3e-3),
                       fused_token_cross_entropy_loss, mesh=create_mesh(),
@@ -277,7 +296,7 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     result = {"metric": metric,
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
     _stamp_overrides(result, ("PTD_BENCH_BS", "PTD_REMAT_POLICY",
-                              "PTD_FUSED_NORMS"))
+                              "PTD_CE_CHUNK", "PTD_FUSED_NORMS"))
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu

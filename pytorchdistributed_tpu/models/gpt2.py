@@ -66,7 +66,8 @@ class GPT2(nn.Module):
         else:
             w, transpose = self.lm_head.kernel, False
         return chunked_softmax_ce(x.astype(cfg.dtype), w.astype(cfg.dtype),
-                                  targets, transpose_w=transpose)
+                                  targets, chunk=cfg.ce_chunk,
+                                  transpose_w=transpose)
 
     @nn.nowrap
     def pipeline_parts(self):

@@ -55,7 +55,7 @@ class Llama(nn.Module):
         x = self._backbone(tokens, deterministic)
         return chunked_softmax_ce(
             x.astype(cfg.dtype), self.lm_head.kernel.astype(cfg.dtype),
-            targets, transpose_w=False)
+            targets, chunk=cfg.ce_chunk, transpose_w=False)
 
     @nn.nowrap
     def pipeline_parts(self):

@@ -66,12 +66,23 @@ class TransformerConfig:
     # GELU flavor: tanh approximation (GPT-2's "gelu_new", the flax
     # default) vs exact erf (BERT's "gelu").
     gelu_approximate: bool = True
-    # Fused custom_vjp norm backward (ops/norms.py) targeting the r3
-    # profile's ~64 ms/step of norm-backward reduce fusions. Opt-in until
-    # measured on the chip (baseline discipline: no unmeasured perf change
-    # rides a recorded config; the tunnel was down when this landed —
-    # flip the default once the A/B is captured).
+    # Fused custom_vjp norm backward (ops/norms.py). A/B'd on the chip
+    # (r5, BASELINE.md): wins only on post-LN BERT (+4.3% — twice the
+    # LayerNorm sites per block); gpt2s wash, gpt2m/vit/llama small
+    # losses. Default stays off; bert's bench config flips it on.
     fused_norms: bool = False
+    # Fused chunked-CE head (ops/fused_ce.py) row-chunk size: rows of
+    # fp32 logits alive at once (chunk x vocab x 4 B — 2048 x 32000 is
+    # ~262 MB on Llama). Smaller chunks trade a little head throughput
+    # for HBM headroom that can buy a bigger batch (the r5 llama bs-10
+    # probe missed fitting by 32 MB at chunk 2048).
+    ce_chunk: int = 2048
+    # Flash/ring/ulysses kernel block size (block_q = block_k). None =
+    # each kernel's own default — flash and ulysses 1024 (measured
+    # fastest for the committed LM configs, BASELINE.md r3/r5), ring 512
+    # (blocks tile the PER-SHARD sequence there). A per-config override
+    # re-opens the block-size A/B without code edits.
+    attn_block: int | None = None
     activation: str = "gelu"            # gelu | swiglu
     rope: bool = False                  # rotary position embedding (no
     #                                     learned pos table when True)
@@ -376,7 +387,15 @@ class SelfAttention(nn.Module):
                 # dk/dv transpose in backward) never materializes.
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal)
+            # flash/ring/ulysses all take the block knobs (shared kernel
+            # bodies); dense has no blocks
+            if cfg.attn_block is not None and cfg.attention != "dense":
+                attn_kwargs = dict(block_q=cfg.attn_block,
+                                   block_k=cfg.attn_block)
+            else:
+                attn_kwargs = {}
+            out = _attention_fn(cfg.attention)(q, k, v, causal=cfg.causal,
+                                               **attn_kwargs)
 
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         out = _dense_general(

@@ -28,7 +28,8 @@ from pytorchdistributed_tpu.runtime.mesh import Axis
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
-                   scale: float | None, impl: str, interpret: bool):
+                   scale: float | None, impl: str, interpret: bool,
+                   block_q: int = 1024, block_k: int = 1024):
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0 or k.shape[2] % n != 0:
         # k/v may carry fewer heads than q (grouped-query); BOTH counts
@@ -48,6 +49,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
         )
 
         out = flash_attention(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
                               interpret=interpret)
     else:
         out = dense_attention(q, k, v, causal=causal, scale=scale)
@@ -58,6 +60,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
                       scale: float | None = None, impl: str = "pallas",
+                      block_q: int = 1024, block_k: int = 1024,
                       interpret: bool | None = None,
                       check_vma: bool | None = None):
     """Sequence-parallel attention via head redistribution; same calling
@@ -82,7 +85,8 @@ def ulysses_attention(q, k, v, *, causal: bool = False, mesh=None,
     spec = P((Axis.DATA, Axis.FSDP), Axis.SEQ, Axis.TENSOR, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=Axis.SEQ, causal=causal,
-                          scale=scale, impl=impl, interpret=interpret),
+                          scale=scale, impl=impl, block_q=block_q,
+                          block_k=block_k, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -195,6 +195,50 @@ def test_fused_ce_loss_matches_unfused():
             np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
 
 
+def test_ce_chunk_config_is_loss_invariant():
+    """cfg.ce_chunk (the r5 HBM-vs-throughput knob, bench.py
+    PTD_CE_CHUNK) resizes the fused head's logit chunks only — loss and
+    gradients must be identical at any chunk size, including one that
+    doesn't divide the token count."""
+    from pytorchdistributed_tpu.models import Llama, llama_config
+    from pytorchdistributed_tpu.training import fused_token_cross_entropy_loss
+
+    rng = np.random.default_rng(9)
+    batch = _token_batch(rng, batch=2, seq=16)
+    losses, grads = [], []
+    for chunk in (4, 12, 1024):
+        model = Llama(llama_config("test", dtype=np.float32,
+                                   ce_chunk=chunk))
+        params = model.init(jax.random.key(0), batch["tokens"])
+        l, g = jax.value_and_grad(
+            lambda p: fused_token_cross_entropy_loss(model, p, batch)[0]
+        )(params)
+        losses.append(float(l))
+        grads.append(g)
+    for l in losses[1:]:
+        np.testing.assert_allclose(l, losses[0], rtol=1e-6)
+    for g in grads[1:]:
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(grads[0])):
+            np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
+
+
+def test_attn_block_config_is_output_invariant():
+    """cfg.attn_block (the r5 block-size A/B knob, bench.py
+    PTD_ATTN_BLOCK) must thread to the flash kernels without changing the
+    math: a pallas model at a non-default block (forcing a multi-block
+    grid with a padded tail at seq 24) matches the dense-attention model
+    exactly."""
+    rng = np.random.default_rng(10)
+    batch = _token_batch(rng, batch=2, seq=24)
+    out = {}
+    for kind, block in (("dense", None), ("pallas", 16)):
+        model = GPT2(gpt2_config("test", dtype=np.float32, attention=kind,
+                                 attn_block=block))
+        params = model.init(jax.random.key(0), batch["tokens"])
+        out[kind] = model.apply(params, batch["tokens"])
+    np.testing.assert_allclose(out["pallas"], out["dense"], atol=2e-5)
+
+
 def test_scan_vs_unrolled_same_shape():
     """scan_layers is a compile-time optimization, not a semantic change."""
     rng = np.random.default_rng(0)

@@ -428,6 +428,35 @@ def test_accum_steps_validations():
         tr.train_step(batch)
 
 
+def test_compiler_options_merge_over_backend_defaults(monkeypatch):
+    """User compiler_options MERGE OVER the backend defaults — a caller
+    tuning an unrelated XLA flag must not silently drop the scoped-VMEM
+    fix (the r5 longcontext compile abort); overriding a default takes
+    setting its key explicitly."""
+    import pytorchdistributed_tpu.training.trainer as trainer_mod
+
+    monkeypatch.setattr(trainer_mod, "_default_compiler_options",
+                        lambda: {"xla_tpu_scoped_vmem_limit_kib": "24576"})
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(),
+                 compiler_options={"xla_some_other_flag": "1"})
+    assert tr._compiler_options == {
+        "xla_tpu_scoped_vmem_limit_kib": "24576",
+        "xla_some_other_flag": "1",
+    }
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh(),
+                 compiler_options={"xla_tpu_scoped_vmem_limit_kib": "16384"})
+    assert tr._compiler_options == {
+        "xla_tpu_scoped_vmem_limit_kib": "16384"}
+    # no backend defaults (CPU) and no user options -> None, not {}
+    monkeypatch.setattr(trainer_mod, "_default_compiler_options",
+                        lambda: None)
+    tr = Trainer(LinearRegression(), optax.sgd(1e-2), mse_loss,
+                 mesh=create_mesh())
+    assert tr._compiler_options is None
+
+
 def test_evaluate_matches_train_loss():
     """eval_step computes the same loss the next train_step reports (before
     its update), and evaluate() sample-weights ragged final batches."""
