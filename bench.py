@@ -167,6 +167,25 @@ def _fused_norms_override(default: bool = False) -> bool:
     return val == "1"
 
 
+def _quant_override(default: str = "none") -> str:
+    """PTD_QUANT={none,int8_fwd,int8} flips the LM benches onto the int8
+    quantized-matmul subsystem (ops/quant.py, TransformerConfig.quant) for
+    chip A/Bs without code edits — the standing-target lever aimed at the
+    measured bf16 plateau (BASELINE.md r5: the MXU's int8 rate is ~2x
+    bf16, so quantizing the weight matmuls attacks the arithmetic ceiling
+    the schedule knobs couldn't). Unset takes the bench's committed
+    default (bf16 — re-pin baselines only after a verified win)."""
+    import os
+
+    val = os.environ.get("PTD_QUANT")
+    if val is None:
+        return default
+    if val not in ("none", "int8_fwd", "int8"):
+        raise SystemExit(f"bench: PTD_QUANT={val!r} must be one of "
+                         f"none|int8_fwd|int8")
+    return val
+
+
 def _stamp_overrides(result: dict,
                      keys: tuple = ("PTD_FUSED_NORMS",)) -> dict:
     """Stamp the A/B env knobs THIS bench actually reads into the record:
@@ -208,7 +227,8 @@ def bench_gpt2(size: str = "small") -> dict:
                       scan_layers=False,
                       ce_chunk=int(os.environ.get("PTD_CE_CHUNK", 2048)),
                       attn_block=int(attn_block) if attn_block else None,
-                      fused_norms=_fused_norms_override())
+                      fused_norms=_fused_norms_override(),
+                      quant=_quant_override())
     model = GPT2(cfg)
     # r2 measured dense CE faster than the fused chunked head for SMALL at
     # batch 8 (BASELINE.md r2-late note); PTD_FUSED_CE=1 re-opens the A/B
@@ -235,7 +255,8 @@ def bench_gpt2(size: str = "small") -> dict:
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
     # PTD_CE_CHUNK only does anything here under the fused head — stamping
     # it on the dense-CE path would taint a committed-config record
-    keys = ("PTD_FUSED_CE", "PTD_ATTN_BLOCK", "PTD_FUSED_NORMS")
+    keys = ("PTD_FUSED_CE", "PTD_ATTN_BLOCK", "PTD_FUSED_NORMS",
+            "PTD_QUANT")
     if os.environ.get("PTD_FUSED_CE") == "1":
         keys += ("PTD_CE_CHUNK",)
     _stamp_overrides(result, keys)
@@ -280,7 +301,8 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
                        remat=True, remat_policy=remat_policy,
                        scan_layers=False, ce_chunk=ce_chunk,
-                       fused_norms=_fused_norms_override())
+                       fused_norms=_fused_norms_override(),
+                       quant=_quant_override())
     trainer = Trainer(Llama(cfg), optax.adafactor(3e-3),
                       fused_token_cross_entropy_loss, mesh=create_mesh(),
                       strategy="dp", log_every=10**9)
@@ -296,7 +318,8 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     result = {"metric": metric,
               "value": round(tokens / sec, 1), "unit": "tokens/s"}
     _stamp_overrides(result, ("PTD_BENCH_BS", "PTD_REMAT_POLICY",
-                              "PTD_CE_CHUNK", "PTD_FUSED_NORMS"))
+                              "PTD_CE_CHUNK", "PTD_FUSED_NORMS",
+                              "PTD_QUANT"))
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu
@@ -331,7 +354,8 @@ def bench_bert(size: str = "base", batch_size: int = 64,
     # _fused_norms_override)
     cfg = bert_config(size, max_seq_len=seq_len, attention=attention,
                       remat=False, scan_layers=False,
-                      fused_norms=_fused_norms_override(default=True))
+                      fused_norms=_fused_norms_override(default=True),
+                      quant=_quant_override())
     trainer = Trainer(BertMLM(cfg), optax.adamw(1e-4),
                       token_cross_entropy_loss, mesh=create_mesh(),
                       strategy="dp", log_every=10**9)
@@ -346,7 +370,7 @@ def bench_bert(size: str = "base", batch_size: int = 64,
     result = {"metric": f"{tag}_mlm_samples_per_s",
               "value": round(batch_size / sec, 1), "unit": "samples/s",
               "tokens_per_s": round(batch_size * seq_len / sec, 1)}
-    _stamp_overrides(result)
+    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT"))
     mfu = _mfu(transformer_train_flops_per_token(cfg)
                * batch_size * seq_len, sec)
     if mfu is not None:
@@ -371,7 +395,8 @@ def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
 
     cfg = vit_config(size, attention="dense", remat=False,
                      scan_layers=False,
-                     fused_norms=_fused_norms_override())
+                     fused_norms=_fused_norms_override(),
+                     quant=_quant_override())
     trainer = Trainer(ViT(cfg), optax.adamw(3e-4), cross_entropy_loss,
                       mesh=create_mesh(), strategy="dp", log_every=10**9)
     rng = np.random.default_rng(0)
@@ -387,7 +412,7 @@ def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
     tag = {"large": "vit_l16"}.get(size, f"vit_{size}_p16")
     result = {"metric": f"{tag}_train_img_per_s",
               "value": round(batch_size / sec, 1), "unit": "img/s"}
-    _stamp_overrides(result)
+    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT"))
     mfu = _mfu(transformer_train_flops_per_token(cfg.transformer)
                * batch_size * seq, sec)
     if mfu is not None:
@@ -501,8 +526,16 @@ def bench_sweep() -> dict:
     throughput; the full table goes to stderr."""
     import sys
 
+    from pytorchdistributed_tpu._jax_compat import (
+        supports_partial_auto_shard_map,
+    )
     from pytorchdistributed_tpu.config import select_backend
 
+    if not supports_partial_auto_shard_map():
+        print("bench: --bench sweep needs the pipeline schedules' "
+              "partial-auto shard_map, which this jax cannot lower "
+              "(same gate as tests/test_pipeline.py)", file=sys.stderr)
+        raise SystemExit(2)
     select_backend("cpu-sim2")  # env + jax.config, before backend init
     import optax
 
@@ -652,8 +685,17 @@ def bench_scaling() -> dict:
     import sys
     import tempfile
 
+    from pytorchdistributed_tpu._jax_compat import (
+        supports_multiprocess_cpu_collectives,
+    )
     from pytorchdistributed_tpu.runtime.launch import launch
     from pytorchdistributed_tpu.utils.metrics import scaling_efficiency
+
+    if not supports_multiprocess_cpu_collectives():
+        print("bench: --bench scaling needs multi-process CPU collectives, "
+              "unimplemented in this jaxlib (use --bench scaling_sim)",
+              file=sys.stderr)
+        raise SystemExit(2)
 
     sec = {}
     for n in (1, 2, 4):

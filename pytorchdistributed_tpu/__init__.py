@@ -37,6 +37,13 @@ if _os.environ.get("JAX_PLATFORMS"):
     except Exception:
         pass
 
+# Backfill current-stable jax API names (jax.set_mesh / jax.shard_map /
+# jax.typeof / jax.sharding.get_abstract_mesh) on images pinning an older
+# jax — strict no-op when the running jax already provides them.
+from pytorchdistributed_tpu import _jax_compat as _jax_compat
+
+_jax_compat.install()
+
 from pytorchdistributed_tpu.runtime.mesh import (  # noqa: F401
     Axis,
     MeshConfig,

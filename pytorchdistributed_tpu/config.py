@@ -50,6 +50,13 @@ class ExperimentConfig:
     moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
     # precision
     bf16: bool = True
+    # Int8 quantized-training matmuls (ops/quant.py, the amp→bf16→int8
+    # axis): "int8_fwd" quantizes forward weight matmuls (bf16 backward,
+    # the safe default for the MXU's ~2x int8 rate), "int8" also
+    # quantizes the backward with stochastic rounding on the gradient.
+    # Applies to the transformer families' QKV/out/MLP/LM-head (and
+    # fused-CE) contractions plus the MLP toy; implies bf16 compute.
+    quant: str = "none"            # none | int8_fwd | int8
     # training
     max_epochs: int = 1
     batch_size: int = 32           # per-process
@@ -196,8 +203,15 @@ def _build_model(cfg: ExperimentConfig):
     if cfg.moe_experts > 0:
         token_cross_entropy_loss = moe_token_cross_entropy_loss
 
-    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    if cfg.quant not in ("none", "int8_fwd", "int8"):
+        raise ValueError(f"unknown --quant {cfg.quant!r} "
+                         "(none | int8_fwd | int8)")
+    # quantized matmuls ride the bf16 compute dtype (the int8 path
+    # rescales through fp32 either way; fp32 "compute" would only slow
+    # the non-matmul remainder)
+    dtype = jnp.bfloat16 if (cfg.bf16 or cfg.quant != "none") else jnp.float32
     tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
+               quant=cfg.quant,
                fused_norms=cfg.fused_norms,
                pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
                pipeline_microbatches=cfg.pipeline_microbatches,
@@ -239,7 +253,9 @@ def _build_model(cfg: ExperimentConfig):
         loss = cross_entropy_loss
         ds = _image_dataset(cfg)
     elif cfg.model == "mlp":
-        model = models.MLP()
+        from pytorchdistributed_tpu.ops.quant import dot_general_for
+
+        model = models.MLP(dot_general=dot_general_for(cfg.quant))
         loss = mse_loss
         ds = SyntheticRegressionDataset(cfg.dataset_size, seed=cfg.seed)
     else:
@@ -404,9 +420,15 @@ def make_trainer(cfg: ExperimentConfig):
 
     model, opt, loss, mesh, ds, cfg = build(cfg)
     loader = DataLoader(ds, batch_size=cfg.batch_size, seed=cfg.seed)
+    if cfg.quant == "int8":
+        precision = Policy.int8()
+    elif cfg.quant == "int8_fwd":
+        precision = Policy.int8_fwd()
+    else:
+        precision = Policy.bf16() if cfg.bf16 else Policy.full()
     trainer = Trainer(
         model, opt, loss, mesh=mesh, strategy=cfg.strategy,
-        precision=Policy.bf16() if cfg.bf16 else Policy.full(),
+        precision=precision,
         log_every=cfg.log_every,
         checkpoint_dir=cfg.checkpoint_dir or None,
         checkpoint_every_steps=cfg.checkpoint_every_steps,

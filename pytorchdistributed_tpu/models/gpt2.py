@@ -58,6 +58,7 @@ class GPT2(nn.Module):
         training.losses.fused_token_cross_entropy_loss. DP/FSDP path — the
         TP/pipeline paths keep the gather-free CE (transformer.py)."""
         from pytorchdistributed_tpu.ops.fused_ce import chunked_softmax_ce
+        from pytorchdistributed_tpu.models.transformer import _cfg_dot_general
 
         cfg = self.cfg
         x = self._backbone(tokens, deterministic)
@@ -67,7 +68,8 @@ class GPT2(nn.Module):
             w, transpose = self.lm_head.kernel, False
         return chunked_softmax_ce(x.astype(cfg.dtype), w.astype(cfg.dtype),
                                   targets, chunk=cfg.ce_chunk,
-                                  transpose_w=transpose)
+                                  transpose_w=transpose,
+                                  dot_general=_cfg_dot_general(cfg))
 
     @nn.nowrap
     def pipeline_parts(self):

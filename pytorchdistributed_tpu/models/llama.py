@@ -50,12 +50,14 @@ class Llama(nn.Module):
                           deterministic: bool = True):
         """Fused chunked-CE head (see GPT2.loss_per_position)."""
         from pytorchdistributed_tpu.ops.fused_ce import chunked_softmax_ce
+        from pytorchdistributed_tpu.models.transformer import _cfg_dot_general
 
         cfg = self.cfg
         x = self._backbone(tokens, deterministic)
         return chunked_softmax_ce(
             x.astype(cfg.dtype), self.lm_head.kernel.astype(cfg.dtype),
-            targets, chunk=cfg.ce_chunk, transpose_w=False)
+            targets, chunk=cfg.ce_chunk, transpose_w=False,
+            dot_general=_cfg_dot_general(cfg))
 
     @nn.nowrap
     def pipeline_parts(self):

@@ -7,7 +7,7 @@ DataParallel lesson (reference 01_multi_gpus_data_parallelism.ipynb cell 5).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 
@@ -21,10 +21,15 @@ class LinearRegression(nn.Module):
 
 
 class MLP(nn.Module):
+    """``dot_general``: optional injectable contraction for every Dense —
+    pass ``Policy.int8_fwd().dot_general()`` (parallel/precision.py) to run
+    the weight matmuls int8-quantized; None = ``lax.dot_general``."""
+
     features: Sequence[int] = (128, 256, 128, 10)
+    dot_general: Any = None
 
     @nn.compact
     def __call__(self, x):
         for f in self.features[:-1]:
-            x = nn.relu(nn.Dense(f)(x))
-        return nn.Dense(self.features[-1])(x)
+            x = nn.relu(nn.Dense(f, dot_general=self.dot_general)(x))
+        return nn.Dense(self.features[-1], dot_general=self.dot_general)(x)

@@ -83,6 +83,16 @@ class TransformerConfig:
     # (blocks tile the PER-SHARD sequence there). A per-config override
     # re-opens the block-size A/B without code edits.
     attn_block: int | None = None
+    # Int8 quantized-training matmuls (ops/quant.py — AQT-style dynamic
+    # per-channel scaling). "none" = bf16 dots (the committed baselines);
+    # "int8_fwd" quantizes the forward weight matmuls (QKV/out, MLP, LM
+    # head / fused-CE logits) and keeps the backward in bf16 — the
+    # convergence-safe default for the MXU's ~2x int8 rate; "int8" also
+    # quantizes both backward contractions with stochastic rounding on the
+    # gradient operand. Sharding annotations are untouched: the injectable
+    # dot_general is plain HLO, so TP's column/row splits, FSDP gathers and
+    # the pipeline stage axis apply to the int8 operands unmodified.
+    quant: str = "none"                 # none | int8_fwd | int8
     activation: str = "gelu"            # gelu | swiglu
     rope: bool = False                  # rotary position embedding (no
     #                                     learned pos table when True)
@@ -136,6 +146,9 @@ class TransformerConfig:
         return self.embed_dim // self.num_heads
 
     def __post_init__(self):
+        if self.quant not in ("none", "int8_fwd", "int8"):
+            raise ValueError(f"unknown quant {self.quant!r}; "
+                             f"one of ('none', 'int8_fwd', 'int8')")
         kv = self.kv_heads
         if kv <= 0 or self.num_heads % kv:
             raise ValueError(
@@ -233,6 +246,16 @@ def _attention_fn(kind: str) -> Callable:
     raise ValueError(f"unknown attention backend {kind!r}")
 
 
+def _cfg_dot_general(cfg, default=None):
+    """The config's injectable contraction: None/``default`` for
+    quant="none", else ops.quant's shared int8 dot_general. One accessor
+    so every weight-matmul site (Dense, fused projections, LM heads,
+    fused-CE) flips together with the flag."""
+    from pytorchdistributed_tpu.ops.quant import dot_general_for
+
+    return dot_general_for(cfg.quant) or default
+
+
 def _dense_general(features: int, kernel_axes, cfg, name, *,
                    use_bias: bool = True):
     """Dense with logically-partitioned kernel. Head projections keep heads
@@ -244,6 +267,7 @@ def _dense_general(features: int, kernel_axes, cfg, name, *,
         use_bias=use_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
+        dot_general=_cfg_dot_general(cfg),
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(stddev=0.02), kernel_axes
         ),
@@ -299,7 +323,9 @@ class SelfAttention(nn.Module):
                 cfg.param_dtype,
             )
             eq = "bse,ecf->bscf" if stack > 1 else "bse,ef->bsf"
-            out = jnp.einsum(eq, x, kernel.astype(cfg.dtype))
+            out = jnp.einsum(eq, x, kernel.astype(cfg.dtype),
+                             _dot_general=_cfg_dot_general(
+                                 cfg, jax.lax.dot_general))
             if cfg.use_bias:
                 bias = self.param(
                     f"{name}_bias",
@@ -431,7 +457,9 @@ class MlpBlock(nn.Module):
                 (cfg.embed_dim, 2, cfg.ffn_dim),
                 cfg.param_dtype,
             )
-            gu = jnp.einsum("bse,ecf->bscf", x, kernel.astype(cfg.dtype))
+            gu = jnp.einsum("bse,ecf->bscf", x, kernel.astype(cfg.dtype),
+                            _dot_general=_cfg_dot_general(
+                                cfg, jax.lax.dot_general))
             if cfg.use_bias:
                 bias = self.param(
                     "wi_bias",
@@ -728,7 +756,12 @@ class LMHead(nn.Module):
         )
 
     def __call__(self, x):
-        return x.astype(self.cfg.dtype) @ self.kernel.astype(self.cfg.dtype)
+        x = x.astype(self.cfg.dtype)
+        kernel = self.kernel.astype(self.cfg.dtype)
+        dg = _cfg_dot_general(self.cfg)
+        if dg is None:
+            return x @ kernel
+        return dg(x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
 
 
 class Embedder(nn.Module):
@@ -774,4 +807,11 @@ class Embedder(nn.Module):
         return x + self.pos[:seq_len].astype(self.cfg.dtype)
 
     def attend(self, x):
-        return self.tok.attend(x.astype(self.cfg.dtype))
+        x = x.astype(self.cfg.dtype)
+        dg = _cfg_dot_general(self.cfg)
+        if dg is None:
+            return self.tok.attend(x)
+        # the tied logit projection [.., embed] x [vocab, embed]ᵀ through
+        # the quantized contraction (same math as Embed.attend)
+        emb = self.tok.embedding.astype(self.cfg.dtype)
+        return dg(x, emb, (((x.ndim - 1,), (1,)), ((), ())))

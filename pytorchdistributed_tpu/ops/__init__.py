@@ -1,3 +1,7 @@
+from pytorchdistributed_tpu.ops.quant import (  # noqa: F401
+    dot_general_for,
+    quantized_dot_general,
+)
 from pytorchdistributed_tpu.ops.collectives import (  # noqa: F401
     all_gather,
     all_reduce_mean,

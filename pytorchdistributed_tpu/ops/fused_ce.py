@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 
 def chunked_softmax_ce(x, w, targets, *, chunk: int = 2048,
-                       transpose_w: bool = True):
+                       transpose_w: bool = True, dot_general=None):
     """Per-position cross-entropy of ``softmax(x @ w.T)`` against integer
     ``targets``, never materializing more than ``chunk`` rows of logits.
 
@@ -35,6 +35,12 @@ def chunked_softmax_ce(x, w, targets, *, chunk: int = 2048,
         sharding note), so a batch wider than ``chunk`` sets the floor.
         The seq axis is padded up to a chunk multiple (padded rows use
         target 0 and are dropped).
+      dot_general: injectable contraction for the logit matmul (default
+        ``lax.dot_general``); the int8 quantized-training path
+        (ops/quant.py, TransformerConfig.quant) passes its drop-in here so
+        the fused head's per-chunk logits ride the MXU's int8 rate too —
+        accumulation stays fp32 out of the contraction, so the logsumexp
+        numerics are unchanged in kind.
 
     Returns per-position CE with ``targets``'s shape, fp32.
 
@@ -76,12 +82,13 @@ def chunked_softmax_ce(x, w, targets, *, chunk: int = 2048,
             [ts, jnp.zeros((b, pad), ts.dtype)], axis=1)
 
     dims = ((2,), (1,)) if transpose_w else ((2,), (0,))
+    dg = dot_general if dot_general is not None else jax.lax.dot_general
 
     @jax.checkpoint
     def one(xc, tc):
         # fp32 accumulation straight out of the MXU — strictly better
         # numerics than the unfused bf16-logits-then-cast path
-        logits = jax.lax.dot_general(
+        logits = dg(
             xc, w, (dims, ((), ())), preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         true = jnp.take_along_axis(logits, tc[:, :, None], axis=-1)[..., 0]

@@ -1,0 +1,272 @@
+"""Int8 quantized-training matmuls — AQT-style dynamic per-channel scaling.
+
+The bf16 MFU plateau (BASELINE.md r5: llama-1B 60.5-62.0%, gpt2-medium
+53.8% after five rounds of kernel-shape and remat-policy A/Bs) is a
+*arithmetic-rate* ceiling, not a schedule one: every remaining knob was
+measured and rejected as noise. The next step-function changes the
+arithmetic itself — TPU v5e's MXU executes int8×int8→int32 at ~2× its
+bf16 rate, and the AQT line of work (Abdolrashidi et al.,
+"Pareto-Optimal Quantized ResNet Is Mostly 4-bit") plus the INT8/FP8
+training-format results (Micikevicius et al., "FP8 Formats for Deep
+Learning") show dynamic per-channel absmax scaling preserves convergence
+for weight-matmul-dominated training.
+
+The primitive here is ``quantized_dot_general(mode)`` — a drop-in for
+``jax.lax.dot_general`` (same signature, so it injects straight into
+``flax.linen.Dense(dot_general=...)`` and ``jnp.einsum(_dot_general=...)``)
+that per call:
+
+  1. computes a dynamic **per-channel absmax scale** for each operand —
+     the absmax over the contraction dims, kept per remaining channel
+     (per activation row, per weight column), so one outlier row cannot
+     flatten the whole tensor's resolution;
+  2. rounds each operand to int8 on that scale and contracts in
+     int8×int8→**int32** (exact integer accumulation — on the MXU this is
+     the ~2× rate path; on CPU/older chips it is a correct reference);
+  3. rescales the int32 result by the outer product of the two scale
+     vectors in fp32 and casts to the caller's result dtype.
+
+Backward (``jax.custom_vjp``, residuals = the unquantized bf16 operands —
+same memory as bf16 training):
+
+  * ``mode="int8_fwd"`` (the safe default): backward runs as ordinary
+    bf16/fp32 ``dot_general`` VJPs. Forward-only quantization is the
+    convergence-conservative recipe — gradients see the quantized loss
+    surface but are themselves full precision.
+  * ``mode="int8"``: both backward contractions (dL/dx = g·Wᵀ and
+    dL/dW = xᵀ·g) also run in int8, with **stochastic rounding on the
+    gradient operand**. Round-to-nearest on gradients biases the many
+    near-zero entries to exactly zero and stalls training; stochastic
+    rounding is unbiased (E[q] = x), the standard int8-backward fix.
+
+Stochastic rounding noise: there is no PRNG stream threaded through the
+model's matmul call sites, so the uniform noise is derived from the
+gradient's own fp32 bit pattern through a murmur3-style avalanche
+finalizer. The mixer decorrelates the noise from the value's fractional
+part (tested: rounding is unbiased to <1e-3 over dense value sweeps), and
+because gradients change every step the noise decorrelates across steps —
+the property plain round-to-nearest lacks.
+
+Sharding: everything here is plain HLO (abs/max/divide/round/convert/dot),
+so the SPMD partitioner shards it like the bf16 matmul it replaces —
+logical-axis annotations on the params and activations are untouched, TP's
+column/row splits still apply to the int8 operands, and a contraction over
+a tensor-sharded dim turns the absmax into a (cheap, correct) cross-shard
+max. The compiled-invariant suite pins the resulting int8 convert/dot mix
+(tests/test_compiled_invariants.py "int8_ops").
+
+Scope: contractions with batch dimensions (the MoE expert-batched einsums)
+are not supported — the weight matmuls this subsystem targets (QKV/out,
+MLP, LM head, fused-CE logits) have none. ``NotImplementedError`` fires
+rather than silently falling back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MODES = ("int8_fwd", "int8")
+
+_QMAX = 127.0  # symmetric int8: codes -127..127 (the -128 code is unused,
+#                keeping the scale exactly absmax/127 and negation exact)
+
+
+class _QuantSpec(NamedTuple):
+    """Static config threaded through custom_vjp as a nondiff arg."""
+
+    mode: str                 # "int8_fwd" | "int8"
+    preferred: np.dtype | None  # caller's preferred_element_type
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def absmax_scale(x, contract_dims):
+    """Per-channel scale [x.shape with contract dims = 1], fp32: absmax
+    over the contraction dims / 127, so the channel's largest magnitude
+    maps to the last int8 code. All-zero channels get scale 1 (their
+    quantized values are 0 regardless; 1 avoids the 0/0)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=contract_dims,
+                   keepdims=True)
+    return jnp.where(amax > 0, amax, jnp.float32(1.0)) / jnp.float32(_QMAX)
+
+
+def quantize(x, scale):
+    """Round-to-nearest int8 on ``scale`` (forward-path rounding)."""
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def _hash_uniform(y):
+    """Uniform [0, 1) noise derived from ``y``'s own fp32 bits via the
+    murmur3 avalanche finalizer. The mixer's output is decorrelated from
+    the input's low-order (fractional) bits — the property stochastic
+    rounding needs — and, unlike a fixed PRNG key, the noise pattern
+    changes whenever the values do (every training step)."""
+    bits = lax.bitcast_convert_type(y.astype(jnp.float32), jnp.uint32)
+    h = bits ^ (bits >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # top-ish 24 bits -> [0, 1): fp32 represents k/2^24 exactly
+    return (h >> np.uint32(8)).astype(jnp.float32) / jnp.float32(1 << 24)
+
+
+def stochastic_quantize(x, scale):
+    """Stochastically-rounded int8: floor(y + u), u ~ U[0,1) — unbiased
+    (E[q·scale] = x), the gradient-operand rounding for mode="int8"."""
+    y = x.astype(jnp.float32) / scale
+    q = jnp.floor(y + _hash_uniform(y))
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+
+
+# ---------------------------------------------------------------------------
+# the int8 contraction (shared by forward and the quantized backward)
+# ---------------------------------------------------------------------------
+
+
+def _int8_dot_value(lhs, rhs, dims, *, sr_lhs=False, sr_rhs=False):
+    """fp32 value of an int8-quantized dot_general (no batch dims):
+    per-channel scales, int8 operands, int32 accumulation, fp32 rescale.
+    ``sr_*`` selects stochastic rounding for that operand (the gradient
+    in the quantized backward)."""
+    (lc, rc), _ = dims
+    ls = absmax_scale(lhs, lc)
+    rs = absmax_scale(rhs, rc)
+    ql = (stochastic_quantize if sr_lhs else quantize)(lhs, ls)
+    qr = (stochastic_quantize if sr_rhs else quantize)(rhs, rs)
+    out = lax.dot_general(ql, qr, dims, preferred_element_type=jnp.int32)
+    # rescale: dot_general output is [lhs_free..., rhs_free...]; line the
+    # squeezed per-channel scales up with trailing/leading broadcast 1s
+    nrf = rhs.ndim - len(rc)
+    ls_o = jnp.squeeze(ls, axis=lc)
+    ls_o = ls_o.reshape(ls_o.shape + (1,) * nrf)
+    rs_o = jnp.squeeze(rs, axis=rc)
+    return out.astype(jnp.float32) * ls_o * rs_o
+
+
+def _grad_dims(lhs_ndim, rhs_ndim, dims):
+    """dot_general dims + output-transpose permutations for the two VJP
+    contractions of a batch-free dot: dlhs = dot(g, rhs) over rhs's free
+    dims, drhs = dot(lhs, g) over lhs's free dims. The cotangent g has
+    layout [lhs_free..., rhs_free...]."""
+    (lc, rc), _ = dims
+    lf = [d for d in range(lhs_ndim) if d not in lc]
+    rf = [d for d in range(rhs_ndim) if d not in rc]
+    nlf = len(lf)
+    # dlhs: contract g's trailing (rhs-free) dims with rhs's free dims;
+    # result is [lf..., sorted(rc)...] — map each rhs contract dim back to
+    # its paired lhs dim and permute into lhs's layout
+    dl_dims = ((tuple(range(nlf, nlf + len(rf))), tuple(rf)), ((), ()))
+    dl_axes = lf + [lc[rc.index(d)] for d in sorted(rc)]
+    dl_perm = tuple(dl_axes.index(a) for a in range(lhs_ndim))
+    # drhs: contract lhs's free dims with g's leading (lhs-free) dims;
+    # result is [sorted(lc)..., rf...]
+    dr_dims = ((tuple(lf), tuple(range(nlf))), ((), ()))
+    dr_axes = [rc[lc.index(d)] for d in sorted(lc)] + rf
+    dr_perm = tuple(dr_axes.index(a) for a in range(rhs_ndim))
+    return (dl_dims, dl_perm), (dr_dims, dr_perm)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core
+# ---------------------------------------------------------------------------
+
+
+def _result_dtype(lhs, rhs, spec: _QuantSpec):
+    if spec.preferred is not None:
+        return spec.preferred
+    return jnp.promote_types(lhs.dtype, rhs.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _quant_dot(lhs, rhs, dims, spec: _QuantSpec):
+    return _int8_dot_value(lhs, rhs, dims).astype(
+        _result_dtype(lhs, rhs, spec))
+
+
+def _quant_dot_fwd(lhs, rhs, dims, spec: _QuantSpec):
+    return _quant_dot(lhs, rhs, dims, spec), (lhs, rhs)
+
+
+def _quant_dot_bwd(dims, spec: _QuantSpec, res, g):
+    lhs, rhs = res
+    if spec.mode == "int8_fwd":
+        # safe default: the backward is the ordinary full-precision VJP of
+        # the reference dot on the saved (unquantized) operands
+        def ref(l, r):
+            return lax.dot_general(l, r, dims,
+                                   preferred_element_type=spec.preferred)
+
+        _, vjp = jax.vjp(ref, lhs, rhs)
+        return tuple(vjp(g))
+    # mode="int8": both grad contractions quantized, stochastic rounding
+    # on the gradient operand (unbiased), round-to-nearest on the saved
+    # forward operands
+    (dl_dims, dl_perm), (dr_dims, dr_perm) = _grad_dims(
+        lhs.ndim, rhs.ndim, dims)
+    dl = jnp.transpose(
+        _int8_dot_value(g, rhs, dl_dims, sr_lhs=True), dl_perm)
+    dr = jnp.transpose(
+        _int8_dot_value(lhs, g, dr_dims, sr_rhs=True), dr_perm)
+    return dl.astype(lhs.dtype), dr.astype(rhs.dtype)
+
+
+_quant_dot.defvjp(_quant_dot_fwd, _quant_dot_bwd)
+
+
+# ---------------------------------------------------------------------------
+# the injectable
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def quantized_dot_general(mode: str):
+    """The ``lax.dot_general`` drop-in for ``mode`` ("int8_fwd" | "int8").
+
+    Cached per mode so every call site shares ONE callable — flax module
+    attributes and jit caches key on identity. ``precision`` is accepted
+    and ignored (the int8 path has exactly one precision);
+    ``preferred_element_type`` selects the result dtype like the real
+    dot_general's."""
+    if mode not in MODES:
+        raise ValueError(f"unknown quantization mode {mode!r}; "
+                         f"one of {MODES} (or 'none' upstream)")
+
+    def dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None):
+        del precision
+        (lc, rc), (lb, rb) = dimension_numbers
+        dims = ((tuple(map(int, lc)), tuple(map(int, rc))),
+                (tuple(map(int, lb)), tuple(map(int, rb))))
+        if dims[1] != ((), ()):
+            raise NotImplementedError(
+                "quantized_dot_general supports contractions without batch "
+                "dimensions (the weight-matmul shapes); got batch dims "
+                f"{dims[1]}")
+        pref = (None if preferred_element_type is None
+                else np.dtype(preferred_element_type))
+        return _quant_dot(lhs, rhs, dims, _QuantSpec(mode, pref))
+
+    dot_general.__name__ = f"int8_dot_general_{mode}"
+    dot_general.__qualname__ = dot_general.__name__
+    return dot_general
+
+
+def dot_general_for(quant: str):
+    """Config-level selector: ``None`` for "none" (callers fall back to
+    ``lax.dot_general``), else the shared injectable for the mode. The one
+    place the model zoo, the fused-CE head and the precision Policy all go
+    through, so flag wiring stays in lockstep."""
+    if quant in (None, "none"):
+        return None
+    return quantized_dot_general(quant)

@@ -385,7 +385,11 @@ def ring_attention_sharded(q, k, v, *, causal: bool = False,
     evaluation mixes varying and invariant index constants that the
     checker rejects ("Primitive dynamic_slice requires varying manual
     axes to match ... please open an issue at github.com/jax-ml/jax") —
-    an interpreter limitation, not a property of this ring."""
+    an interpreter limitation, not a property of this ring. The checked
+    default covers ``impl="xla"`` too; its acceptance is pinned by
+    tests/test_attention.py::test_ring_xla_impl_checked_sim (trace-time
+    property, sim-testable) plus one checked xla step in the TPU-gated
+    hardware-evidence tests (ADVICE r5)."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is None or not mesh.axis_names:

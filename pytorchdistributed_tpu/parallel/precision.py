@@ -1,10 +1,22 @@
-"""Mixed precision — the amp→bf16 port (BASELINE.json north star).
+"""Mixed precision — the amp→bf16 port (BASELINE.json north star), extended
+down the same axis to int8 quantized training.
 
 CUDA amp (GradScaler + fp16 autocast) does not map to TPU: the MXU's native
 wide type is bfloat16, which shares float32's exponent range, so no loss
 scaling is needed. The policy is therefore just a dtype triple: keep master
 params in fp32, run compute (matmuls/convs on the MXU) in bf16, accumulate
 reductions in fp32.
+
+The ``quant`` field continues the amp→bf16 progression to the MXU's ~2×
+int8 rate (ops/quant.py — AQT-style dynamic per-channel scaling): params
+and non-matmul math stay exactly the bf16 policy's; only the weight
+contractions run int8×int8→int32 behind an injectable ``dot_general``.
+``Policy.int8_fwd()`` quantizes forward matmuls with a bf16 backward (the
+convergence-safe default); ``Policy.int8()`` also quantizes the backward
+contractions with stochastic rounding on the gradient operand. Models wire
+the injectable through ``TransformerConfig.quant`` (config.py keeps the
+two in lockstep from one ``--quant`` flag); ``Policy.dot_general()``
+exposes the same injectable for ad-hoc models (e.g. ``models.mlp.MLP``).
 """
 
 from __future__ import annotations
@@ -27,11 +39,13 @@ def _cast_floating(tree, dtype):
 @dataclasses.dataclass(frozen=True)
 class Policy:
     """param_dtype: master copy; compute_dtype: forward/backward math;
-    output_dtype: loss/metrics accumulation."""
+    output_dtype: loss/metrics accumulation; quant: weight-matmul
+    quantization mode ("none" | "int8_fwd" | "int8", ops/quant.py)."""
 
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
     output_dtype: jnp.dtype = jnp.float32
+    quant: str = "none"
 
     @staticmethod
     def bf16() -> "Policy":
@@ -41,6 +55,27 @@ class Policy:
     @staticmethod
     def full() -> "Policy":
         return Policy()
+
+    @staticmethod
+    def int8_fwd() -> "Policy":
+        """bf16 policy + int8 forward weight matmuls (dynamic per-channel
+        scales), backward in bf16 — the safe quantized-training default."""
+        return Policy(compute_dtype=jnp.bfloat16, quant="int8_fwd")
+
+    @staticmethod
+    def int8() -> "Policy":
+        """bf16 policy + int8 forward AND backward weight matmuls
+        (stochastic rounding on the gradient operand)."""
+        return Policy(compute_dtype=jnp.bfloat16, quant="int8")
+
+    def dot_general(self):
+        """The policy's injectable contraction: None for quant="none"
+        (callers use ``lax.dot_general``), else the shared int8 drop-in —
+        the same callable TransformerConfig.quant injects, exposed here
+        for models built outside the transformer core."""
+        from pytorchdistributed_tpu.ops.quant import dot_general_for
+
+        return dot_general_for(self.quant)
 
     def cast_params_for_compute(self, params):
         """Cast floating leaves to the compute dtype — EXCEPT normalization

@@ -68,13 +68,27 @@ BN_EMA_MOMENTUM = 0.9
 # scoped vmem limit" (2026-07-31; chunking the kernel call does NOT help —
 # the chunks' staged outputs are concurrently live, so the frame total is
 # unchanged). 24 MiB clears the padded frame with room to spare and is
-# far under physical VMEM (~128 MiB on v5e; the conservative default
-# exists for pre-v4 chips).
+# far under physical VMEM on v4+ (~128 MiB on v5e).
 _TPU_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "24576"}
 
 
 def _default_compiler_options() -> dict[str, str] | None:
+    """The raised scoped-VMEM default, gated on TPU GENERATION (ADVICE
+    r5): v2/v3 cores have ~16 MiB physical VMEM, so a 24 MiB scoped limit
+    exceeds the hardware and can itself break compilation — XLA's
+    conservative 16 MiB default exists for exactly those chips. Only v4
+    and later (device_kind "TPU v4" / "TPU v5 lite" / "TPU v5p" / "TPU
+    v6e" ...) get the override; unparseable kinds stay on XLA defaults."""
     if jax.default_backend() != "tpu":
+        return None
+    import re
+
+    # first integer in the kind string: "TPU v5 lite" -> 5, "TPU v4" -> 4,
+    # and generation tokens without the 'v' ("TPU7x" -> 7) — failing open
+    # on an unparseable kind would silently drop the long-sequence compile
+    # fix on exactly the newest chips
+    m = re.search(r"(\d+)", jax.devices()[0].device_kind)
+    if m is None or int(m.group(1)) < 4:
         return None
     return dict(_TPU_COMPILER_OPTIONS)
 

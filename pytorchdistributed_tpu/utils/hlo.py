@@ -48,6 +48,19 @@ def collective_counts(hlo_text: str) -> dict[str, int]:
     }
 
 
+def int8_counts(hlo_text: str) -> dict[str, int]:
+    """Census of the int8 quantized-matmul op mix (ops/quant.py):
+    ``s8_values`` — instructions producing an s8 tensor (the per-operand
+    quantize converts; fusion bodies included, the text covers them);
+    ``int_dots`` — dot instructions with s32 (int-accumulated) output.
+    Both zero in an unquantized program, which is itself a tripwire: an
+    int8 op appearing in a bf16 config's step is never an accident."""
+    return {
+        "s8_values": len(re.findall(r"= s8\[", hlo_text)),
+        "int_dots": len(re.findall(r"= s32\[[^\]]*\]\S* dot\(", hlo_text)),
+    }
+
+
 def compiled_invariants(compiled) -> dict:
     """The committed-invariant dict for one compiled train step.
 
@@ -65,13 +78,19 @@ def compiled_invariants(compiled) -> dict:
       holds two copies of params+opt state and a model sized near HBM
       OOMs. alias ≈ state bytes is the proof donation still holds.
     * ``collectives`` — `collective_counts` of the optimized HLO.
+    * ``int8_ops`` — `int8_counts`: the quantized-matmul convert/dot mix
+      (all-zero for unquantized configs).
     """
     mem = compiled.memory_analysis()
     cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):  # older jax wraps it in a list
+        cost = cost[0] if cost else {}
+    text = compiled.as_text()
     return {
         "flops": float(cost.get("flops", -1.0)),
         "temp_bytes": int(mem.temp_size_in_bytes),
         "arg_bytes": int(mem.argument_size_in_bytes),
         "alias_bytes": int(mem.alias_size_in_bytes),
-        "collectives": collective_counts(compiled.as_text()),
+        "collectives": collective_counts(text),
+        "int8_ops": int8_counts(text),
     }

@@ -39,7 +39,16 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from pytorchdistributed_tpu._jax_compat import (
+    supports_partial_auto_shard_map,
+)
 from pytorchdistributed_tpu.utils.hlo import compiled_invariants
+
+# The 1F1B / GPipe schedules need shard_map with axis_names ⊂ mesh axes;
+# jax versions whose shard_map had to be backfilled (0.4.x) cannot lower
+# that shape at all (spmd partitioner aborts) — the pipeline configs skip
+# there instead of failing on an environment limitation.
+PIPELINE_CONFIGS = ("pp4_1f1b", "gpt2s_4l_pp4")
 
 # ---------------------------------------------------------------------------
 # config builders: name -> (trainer, sample_batch)
@@ -162,6 +171,14 @@ BUILDERS = {
     "dp8": _structural({}, dict(data=8), "dp"),
     "fsdp8": _structural({}, dict(fsdp=8), "fsdp"),
     "tp4_dp2": _structural({}, dict(data=2, tensor=4), "tp"),
+    # the int8 quantized step's structural signature (ops/quant.py):
+    # same dp program with the weight matmuls quantized — the int8_ops
+    # census pins the convert/dot mix (5 weight-matmul sites x 2 operand
+    # converts forward; int8_fwd keeps the backward in bf16, so the int
+    # dot count is the forward sites only)
+    "dp8_int8fwd": _structural(dict(quant="int8_fwd"), dict(data=8), "dp"),
+    "tp4_dp2_int8fwd": _structural(dict(quant="int8_fwd"),
+                                   dict(data=2, tensor=4), "tp"),
     "pp4_1f1b": _structural(
         dict(num_layers=4, pipeline_stages=4, pipeline_microbatches=8,
              pp_schedule="1f1b"),
@@ -188,53 +205,110 @@ BUILDERS = {
         pipeline_stages=4, pipeline_microbatches=8, pp_schedule="1f1b",
         scan_layers=True),  # the 1F1B stage decomposition requires it
     "llama1b_2l": _flagship_llama(),
+    # the quantized flagship (ISSUE 1 acceptance): bench_gpt2's committed
+    # recipe at depth 2 with --quant int8_fwd — per-device flops and the
+    # int8 convert/dot mix are the committed tripwire for the quantized
+    # train step at real widths (the int8 LM-head dot against the 50257
+    # vocab dominates; a site silently falling back to bf16 changes
+    # int8_ops immediately)
+    "gpt2s_2l_int8fwd": _flagship_gpt2("small", quant="int8_fwd"),
     "resnet50_b32": _flagship_resnet(),
 }
 
-QUICK_NAMES = ("dp8", "fsdp8", "tp4_dp2", "pp4_1f1b", "ring_seq2",
-               "ulysses_seq2", "moe_ep4")
+QUICK_NAMES = ("dp8", "fsdp8", "tp4_dp2", "dp8_int8fwd", "tp4_dp2_int8fwd",
+               "pp4_1f1b", "ring_seq2", "ulysses_seq2", "moe_ep4")
 
 # Captured by scripts/capture_invariants.py on the frozen image's
 # jax/XLA; deterministic (verified identical across cold and cache-warm
-# compiles). Update ritual in the module docstring. Notes on what the
-# numbers say: dp is ONE fused grad all-reduce (+1 for the loss mean);
-# fsdp's 9 all-gathers are the ZeRO-3 param regathers; the 1F1B pipe's
-# collective-permutes are the stage rotations; ring rotates KV 8 times
-# where Ulysses all-to-alls heads 8 times (the two CP dialects' signature
-# difference, visible right here); resnet50's ~100 all-reduces are
-# sync-BN's per-layer batch statistics (53 BNs), the TPU-native
-# SyncBatchNorm.
+# compiles). Update ritual in the module docstring.
+#
+# FULL RE-CAPTURE (ISSUE 1 / the jax 0.4.x image): the committed numbers
+# are XLA-version-dependent BY DESIGN, and the current frozen image pins
+# an older jax/XLA than the one the r5 numbers were captured on (the r5
+# toolchain fused the dp grad all-reduces into ~2; this XLA leaves ~18-30
+# unfused, partitions some MoE/TP einsums differently, and runs the flash
+# kernels' dense stand-ins through different fusions). Every capturable
+# config was re-pinned on this image 2026-08-04 (BASELINE.md entry); the
+# two pipeline configs keep their r5 entries because this jax cannot
+# lower partial-auto shard_map at all — they SKIP with that reason and
+# re-arm unchanged on a capable image. What the numbers say, this
+# capture: ring rotates KV 8 times (collective-permute 8) where Ulysses
+# all-to-alls heads 8 times — the two CP dialects' signature difference
+# survives the XLA version change; resnet50's all-reduces are sync-BN's
+# per-layer batch statistics (unfused here); the *_int8fwd configs are
+# the quantized-training tripwires — their int8_ops census pins the
+# convert/dot mix (2 converts per weight-matmul site; int8_fwd = forward
+# sites only carry int dots, the backward stays bf16) and their flops sit
+# ~2% over the bf16 twin (the absmax/rescale elementwise adds — the
+# arithmetic the MXU's 2x int8 rate pays for).
 COMMITTED: dict[str, dict] = {
     "dp8": {
-        "flops": 131045120.0,
-        "temp_bytes": 8681496,
+        "flops": 131339560.0,
+        "temp_bytes": 9105272,
         "arg_bytes": 1399816,
         "alias_bytes": 1397768,
-        "collectives": {"all-reduce": 2, "all-gather": 0,
+        "collectives": {"all-reduce": 18, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     "fsdp8": {
-        "flops": 147790336.0,
-        "temp_bytes": 14079520,
+        "flops": 267927088.0,
+        "temp_bytes": 41244096,
         "arg_bytes": 186184,
         "alias_bytes": 184136,
-        "collectives": {"all-reduce": 11, "all-gather": 9,
+        "collectives": {"all-reduce": 20, "all-gather": 16,
                         "reduce-scatter": 0, "collective-permute": 0,
-                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "all-to-all": 5, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     "tp4_dp2": {
-        "flops": 142376816.0,
-        "temp_bytes": 11496920,
+        "flops": 134253744.0,
+        "temp_bytes": 10039872,
         "arg_bytes": 439432,
         "alias_bytes": 431240,
-        "collectives": {"all-reduce": 10, "all-gather": 0,
+        "collectives": {"all-reduce": 35, "all-gather": 11,
+                        "reduce-scatter": 0, "collective-permute": 5,
+                        "all-to-all": 4, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+    },
+    # the quantized structural signatures: same programs as dp8/tp4_dp2
+    # with the weight matmuls int8. Under dp the collective census must
+    # NOT move (18 == 18: per-channel scales are shard-local there, so
+    # quantization changes arithmetic only); under TP it legitimately
+    # DOES (39/17 vs 35/11: a contraction over a tensor-sharded dim turns
+    # the absmax into a cross-shard max — ops/quant.py's sharding note),
+    # which is exactly why the TP pair is pinned separately. int8_ops:
+    # 10 = 5 weight-matmul sites x 2 operand converts under dp; TP shards
+    # the converts so more s8-producing instructions appear; 5 int dots
+    # either way
+    "dp8_int8fwd": {
+        "flops": 134337312.0,
+        "temp_bytes": 9075064,
+        "arg_bytes": 1399816,
+        "alias_bytes": 1397768,
+        "collectives": {"all-reduce": 18, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 10, "int_dots": 5},
     },
+    "tp4_dp2_int8fwd": {
+        "flops": 136199872.0,
+        "temp_bytes": 9813128,
+        "arg_bytes": 439432,
+        "alias_bytes": 431240,
+        "collectives": {"all-reduce": 39, "all-gather": 17,
+                        "reduce-scatter": 0, "collective-permute": 5,
+                        "all-to-all": 4, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 25, "int_dots": 5},
+    },
+    # r5 entry KEPT (not capturable on this image — partial-auto
+    # shard_map; the test skips with that reason rather than failing)
     "pp4_1f1b": {
         "flops": 89115424.0,
         "temp_bytes": 2992960,
@@ -246,84 +320,82 @@ COMMITTED: dict[str, dict] = {
                         "collective-broadcast": 0},
     },
     "ring_seq2": {
-        "flops": 118030232.0,
-        "temp_bytes": 7425056,
+        "flops": 117956672.0,
+        "temp_bytes": 8259392,
         "arg_bytes": 1399816,
         "alias_bytes": 1397768,
-        "collectives": {"all-reduce": 5, "all-gather": 3,
+        "collectives": {"all-reduce": 38, "all-gather": 6,
                         "reduce-scatter": 0, "collective-permute": 8,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     "ulysses_seq2": {
-        "flops": 120004488.0,
-        "temp_bytes": 7310272,
+        "flops": 119991728.0,
+        "temp_bytes": 8193824,
         "arg_bytes": 1399816,
         "alias_bytes": 1397768,
-        "collectives": {"all-reduce": 5, "all-gather": 3,
+        "collectives": {"all-reduce": 38, "all-gather": 6,
                         "reduce-scatter": 0, "collective-permute": 2,
                         "all-to-all": 8, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     # NOTE the zero all-to-all: at these shapes XLA partitions the
     # one-hot dispatch einsums into all-gather + all-reduce rather than a
     # literal all-to-all — the census records what the compiler actually
     # emits, which is exactly why it's worth pinning.
     "moe_ep4": {
-        "flops": 851241152.0,
-        "temp_bytes": 47304472,
+        "flops": 852428288.0,
+        "temp_bytes": 45698232,
         "arg_bytes": 1399816,
         "alias_bytes": 1391624,
-        "collectives": {"all-reduce": 12, "all-gather": 3,
+        "collectives": {"all-reduce": 30, "all-gather": 3,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     "gpt2s_2l": {
-        "flops": 348919955456.0,
-        "temp_bytes": 1316690288,
+        "flops": 348754477056.0,
+        "temp_bytes": 1170860256,
         "arg_bytes": 642741256,
         "alias_bytes": 642733064,
-        "collectives": {"all-reduce": 1, "all-gather": 0,
+        "collectives": {"all-reduce": 30, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
     "gpt2m_2l": {
-        "flops": 503792271360.0,
-        "temp_bytes": 1587454320,
+        "flops": 503503126528.0,
+        "temp_bytes": 1583153440,
         "arg_bytes": 932483080,
         "alias_bytes": 932474888,
-        "collectives": {"all-reduce": 1, "all-gather": 0,
+        "collectives": {"all-reduce": 30, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
-    # Census caveat, verified with a minimal probe: XLA:CPU lowers the
-    # canonical grad reduce-scatter pattern (contraction over the sharded
-    # batch, output sharded like the param) as all-reduce + slice — it
-    # never emits reduce-scatter ops. So fsdp rows legitimately show
-    # reduce-scatter 0 here; on TPU the same programs get the
-    # ReduceScatterCreator pass. The CPU census is still a valid tripwire
-    # (a change in the all-reduce/all-gather counts is a change in the
-    # program), just not a bandwidth model of the TPU lowering. The
-    # ~6 GB temp here is likewise CPU-inflated: full all-reduced grads
-    # live before slicing.
+    # Census caveat, verified with a minimal probe on the r5 image:
+    # XLA:CPU lowers the canonical grad reduce-scatter pattern as
+    # all-reduce + slice — fsdp rows legitimately show reduce-scatter 0
+    # here; on TPU the same programs get the ReduceScatterCreator pass.
+    # The CPU census is still a valid tripwire, just not a bandwidth
+    # model of the TPU lowering.
     "gpt2m_2l_fsdp8": {
-        "flops": 513154646016.0,
-        "temp_bytes": 5980155704,
+        "flops": 507647164416.0,
+        "temp_bytes": 1075243392,
         "arg_bytes": 116718088,
         "alias_bytes": 116709896,
-        "collectives": {"all-reduce": 19, "all-gather": 15,
+        "collectives": {"all-reduce": 29, "all-gather": 49,
                         "reduce-scatter": 0, "collective-permute": 0,
-                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "all-to-all": 2, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
-    # The 27 all-reduces decompose (audited via op_name metadata) into
-    # microbatch-shaped activation psums — the masked pipe-axis combine
-    # of the lockstep SPMD schedule — plus one per weight-grad dot; the
-    # census counts STATIC instructions, and the 1F1B while-loop executes
-    # its 2 collective-permutes once per tick.
+    # r5 entry KEPT (not capturable on this image — see pp4_1f1b)
     "gpt2s_4l_pp4": {
         "flops": 309091106816.0,
         "temp_bytes": 1861801464,
@@ -334,30 +406,45 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
     },
-    # Re-pinned after the r5 fused-CE seq-chunking fix (BASELINE.md): the
-    # original capture showed 5 all-gathers + 1.35e12 per-device flops —
-    # the batch-axis-sliced CE chunks were making the partitioner gather
-    # neighbors' hidden states and redundantly compute their CE rows.
-    # Chunking seq instead: zero all-gathers, 30% fewer per-device flops.
+    # (the r5 fused-CE seq-chunking fix's zero-all-gather property —
+    # BASELINE.md "First catch" — still holds under this XLA: no
+    # all-gathers in the pure-DP llama program)
     "llama1b_2l": {
-        "flops": 947261276160.0,
-        "temp_bytes": 2622011976,
+        "flops": 947184205824.0,
+        "temp_bytes": 1510256960,
         "arg_bytes": 1011542024,
         "alias_bytes": 1011533832,
-        "collectives": {"all-reduce": 2, "all-gather": 0,
+        "collectives": {"all-reduce": 18, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+    },
+    # the quantized flagship (ISSUE 1 acceptance): 18 converts / 9 int
+    # dots = 2 unrolled layers x 4 weight-matmul sites + the tied LM
+    # head; flops +0.4% over gpt2s_2l (absmax/rescale elementwise), temp
+    # -4% (int8 operand buffers are a quarter the bf16 footprint)
+    "gpt2s_2l_int8fwd": {
+        "flops": 350091378688.0,
+        "temp_bytes": 1124532448,
+        "arg_bytes": 642741256,
+        "alias_bytes": 642733064,
+        "collectives": {"all-reduce": 30, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 18, "int_dots": 9},
     },
     "resnet50_b32": {
-        "flops": 105789972480.0,
-        "temp_bytes": 499951336,
+        "flops": 98719342592.0,
+        "temp_bytes": 425349288,
         "arg_bytes": 207077204,
         "alias_bytes": 204668740,
-        "collectives": {"all-reduce": 100, "all-gather": 0,
+        "collectives": {"all-reduce": 375, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
     },
 }
 
@@ -380,6 +467,12 @@ def _assert_invariants(name, inv, want):
         f"{inv['alias_bytes']}, committed {want['alias_bytes']} — if it "
         f"DROPPED, state donation broke (jax only warns) and the step now "
         f"holds two copies of params+opt state")
+    if "int8_ops" in want:
+        assert inv["int8_ops"] == want["int8_ops"], (
+            f"{name}: int8 convert/dot mix changed: got {inv['int8_ops']}, "
+            f"committed {want['int8_ops']} — a quantized site silently "
+            f"falling back to bf16 (or an int8 op leaking into a bf16 "
+            f"config) shows up exactly here")
     lo = want["temp_bytes"] * (1 - TEMP_BYTES_RTOL)
     hi = want["temp_bytes"] * (1 + TEMP_BYTES_RTOL)
     assert lo <= inv["temp_bytes"] <= hi, (
@@ -388,6 +481,9 @@ def _assert_invariants(name, inv, want):
 
 
 def _check(name):
+    if name in PIPELINE_CONFIGS and not supports_partial_auto_shard_map():
+        pytest.skip("pipeline schedules need partial-auto shard_map "
+                    "(axis_names ⊂ mesh axes), unsupported by this jax")
     trainer, batch = BUILDERS[name]()
     inv = compiled_invariants(trainer.lower_step(batch).compile())
     _assert_invariants(name, inv, COMMITTED[name])
@@ -405,13 +501,14 @@ def test_flagship_invariants(name):
 
 
 DECODE_COMMITTED: dict = {
-    "flops": 226508308480.0,
-    "temp_bytes": 811830472,
+    "flops": 226509897728.0,
+    "temp_bytes": 666758832,
     "arg_bytes": 214252552,
     "alias_bytes": 0,  # generate() does not donate — no state to reuse
     "collectives": {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
                     "collective-permute": 0, "all-to-all": 0,
                     "ragged-all-to-all": 0, "collective-broadcast": 0},
+    "int8_ops": {"s8_values": 0, "int_dots": 0},
 }
 
 

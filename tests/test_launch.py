@@ -10,7 +10,19 @@ import textwrap
 
 import pytest
 
+from pytorchdistributed_tpu._jax_compat import (
+    supports_multiprocess_cpu_collectives,
+)
 from pytorchdistributed_tpu.runtime.launch import launch
+
+# Real-process jax.distributed collectives on the CPU backend need a
+# jaxlib that implements multi-process CPU computations; the 0.4.x-era
+# jaxlib rejects them outright ("Multiprocess computations aren't
+# implemented on the CPU backend") — environment gate, same vintage
+# marker as the shard_map backfill (see _jax_compat).
+_needs_multiproc = pytest.mark.skipif(
+    not supports_multiprocess_cpu_collectives(),
+    reason="multi-process CPU collectives unimplemented in this jaxlib")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -45,6 +57,7 @@ def _hang_or_fail_worker(rank):
     time.sleep(600)  # rank 0 blocks (e.g. in a collective) forever
 
 
+@_needs_multiproc
 def test_spawn_style_collective():
     """The mp.spawn path (reference ddp_gpus.py:98): 2 processes rendezvous
     via the env contract and complete a cross-process collective."""
@@ -79,6 +92,7 @@ def test_sim_device_flags_deduplicated():
     assert "--foo=1" in out and "--bar=2" in out
 
 
+@_needs_multiproc
 def test_torchrun_style_cli(tmp_path):
     """The torchrun path (reference ddp_gpus_torchrun.py:102): the run CLI
     sets the env contract; the script reads it via init_process_group."""
@@ -194,6 +208,7 @@ def test_torchrun_style_elastic_restart(tmp_path):
     assert "restart 1/1" in proc.stderr
 
 
+@_needs_multiproc
 def test_elastic_restart_resumes_real_training(tmp_path):
     """The launcher's restart-resume promise, end to end (VERDICT r4 #5 /
     weak #4 — every other launcher test uses synthetic exit-code workers):
