@@ -16,7 +16,11 @@ repeats after a compile warmup, mean reported; 03_model_parallel.ipynb:
 vs_baseline compares against COMMITTED absolute targets (the round-1
 measurements recorded in BASELINE.md) — a number this harness can never
 quietly move. The GPT-2 bench additionally reports MFU from the analytic
-model-FLOPs formula so the utilization claim is checkable.
+model-FLOPs formula so the utilization claim is checkable, and every
+Trainer-based bench stamps ``comm_bytes_per_step`` (and, where no
+analytic MFU exists, a cost-analysis ``mfu``) from
+telemetry.StepAccounting — the same numbers the telemetry run report
+derives (PTD_BENCH_ACCOUNTING=0 skips the extra AOT compile they cost).
 """
 
 from __future__ import annotations
@@ -92,26 +96,53 @@ def _vs_baseline(metric: str, value: float) -> float | None:
     return round(value / COMMITTED_BASELINES[metric], 3)
 
 
-# Peak bf16 matmul throughput per chip, by jax device_kind. Used only to
-# report MFU; unknown kinds simply omit it.
-_PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def _mfu(flops_per_step: float, sec_per_step: float) -> float | None:
+    """Analytic MFU against the per-generation peak table (owned by
+    telemetry/accounting.py). HARDWARE kinds only: an unlabeled bench
+    "mfu" must always mean utilization of a real chip, so the CPU sim's
+    NOMINAL fallback peak is refused here — sim runs get their MFU from
+    `_accounting_fields`, which stamps the peak source alongside it."""
     import jax
 
-    peak = _PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
+    from pytorchdistributed_tpu.telemetry import PEAK_BF16_FLOPS
+
+    peak = PEAK_BF16_FLOPS.get(jax.devices()[0].device_kind)
     if peak is None:
         return None
     return round(flops_per_step / sec_per_step / peak, 4)
+
+
+def _accounting_fields(trainer, batch, result: dict, sec: float) -> dict:
+    """Stamp StepAccounting-derived fields into a bench record:
+    ``comm_bytes_per_step`` always, ``mfu`` only where the bench didn't
+    already report the analytic-formula MFU (the two denominators differ
+    — cost-analysis flops include remat recompute, the analytic formula
+    counts model flops once — and the committed MFU story stays
+    analytic). Costs one extra AOT compile of the already-built step
+    (cheap under a persistent compile cache); PTD_BENCH_ACCOUNTING=0
+    skips it, and any failure degrades to omitting the fields — a
+    telemetry quirk must not sink a bench run."""
+    import os
+    import sys
+
+    if os.environ.get("PTD_BENCH_ACCOUNTING") == "0":
+        return result
+    try:
+        acct = trainer.step_accounting(batch)
+    except Exception as e:
+        print(f"bench: step accounting skipped ({e})", file=sys.stderr)
+        return result
+    result["comm_bytes_per_step"] = acct.comm_bytes_per_step
+    if "mfu" not in result:
+        mfu = acct.mfu(sec)
+        if mfu is not None:
+            # labeled on BOTH axes: where the flops came from and which
+            # peak divided them — a sim-fallback MFU must never read as a
+            # hardware utilization claim
+            result["mfu"] = mfu
+            result["mfu_source"] = "xla_cost_analysis"
+            result["mfu_peak"] = acct.peak_source
+    return result
 
 
 def transformer_train_flops_per_token(cfg) -> float:
@@ -263,7 +294,7 @@ def bench_gpt2(size: str = "small") -> dict:
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu
-    return result
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
@@ -323,7 +354,7 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu
-    return result
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_bert(size: str = "base", batch_size: int = 64,
@@ -375,7 +406,7 @@ def bench_bert(size: str = "base", batch_size: int = 64,
                * batch_size * seq_len, sec)
     if mfu is not None:
         result["mfu"] = mfu
-    return result
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
@@ -417,7 +448,7 @@ def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
                * batch_size * seq, sec)
     if mfu is not None:
         result["mfu"] = mfu
-    return result
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_resnet50() -> dict:
@@ -442,8 +473,9 @@ def bench_resnet50() -> dict:
         "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
     }
     sec = _time_steps(trainer, batch, steps=10)
-    return {"metric": "resnet50_train_img_per_s",
-            "value": round(batch_size / sec, 1), "unit": "img/s"}
+    result = {"metric": "resnet50_train_img_per_s",
+              "value": round(batch_size / sec, 1), "unit": "img/s"}
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_generate() -> dict:
@@ -512,8 +544,9 @@ def bench_mlp() -> dict:
     loader = DataLoader(ds, batch_size=batch_size, num_replicas=1, rank=0)
     batch = next(iter(loader))
     sec = _time_steps(trainer, batch)
-    return {"metric": "mlp_dp_training_throughput",
-            "value": round(batch_size / sec, 1), "unit": "samples/s"}
+    result = {"metric": "mlp_dp_training_throughput",
+              "value": round(batch_size / sec, 1), "unit": "samples/s"}
+    return _accounting_fields(trainer, batch, result, sec)
 
 
 def bench_sweep() -> dict:

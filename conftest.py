@@ -79,6 +79,16 @@ _QUICK = (
     "test_moe.py::test_single_expert_is_dense_mlp",
     "test_moe.py::test_moe_aux_loss_uniform_at_balance",
     "test_torch_import.py",                   # torch->TPU logit parity
+    # telemetry subsystem: tracer/accounting/tripwire units + the
+    # single-process end-to-end smoke (train with telemetry on → report);
+    # the 2-process report run stays full-suite-only
+    "test_telemetry.py::test_span_tracer_chrome_roundtrip",
+    "test_telemetry.py::test_span_overhead_under_budget",
+    "test_telemetry.py::test_collective_bytes_parses_shapes",
+    "test_telemetry.py::test_step_accounting_mlp_hand_computed",
+    "test_telemetry.py::test_anomaly_detector_non_finite_and_spike",
+    "test_telemetry.py::test_tripwires_fire_on_injected_nan_loss",
+    "test_telemetry.py::test_telemetry_smoke_end_to_end",
     # compiled-artifact tripwires: the structural (test-size) tier + the
     # analytic-FLOPs pins; the flagship-width tier stays full-suite-only
     # (CPU compiles are ~30-100 s each cold)

@@ -13,6 +13,7 @@ double-buffered prefetcher overlaps host gather + H2D DMA with device compute
 from __future__ import annotations
 
 import collections
+import contextlib
 from typing import Iterator
 
 import jax
@@ -86,12 +87,19 @@ def prefetch_to_device(
     iterator: Iterator[dict[str, np.ndarray]],
     sharding,
     size: int = 2,
+    tracer=None,
 ) -> Iterator[dict[str, jax.Array]]:
     """Double-buffer: keep ``size`` batches in flight on device so the H2D
-    transfer of batch k+1 overlaps the compute of batch k."""
+    transfer of batch k+1 overlaps the compute of batch k. ``tracer`` (a
+    telemetry.SpanTracer) records each shard/H2D handoff as an
+    "h2d_transfer" host span — note the span covers the *dispatch* of the
+    transfer; the DMA itself overlaps compute by design."""
     queue: collections.deque = collections.deque()
     for batch in iterator:
-        queue.append(shard_batch(batch, sharding))
+        cm = (tracer.span("h2d_transfer") if tracer is not None
+              else contextlib.nullcontext())
+        with cm:
+            queue.append(shard_batch(batch, sharding))
         if len(queue) >= size:
             yield queue.popleft()
     while queue:

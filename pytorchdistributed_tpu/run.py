@@ -67,6 +67,10 @@ from pytorchdistributed_tpu.runtime.heartbeat import (
     HEARTBEAT_DIR_ENV,
     stale_ranks,
 )
+from pytorchdistributed_tpu.telemetry.events import (
+    TELEMETRY_DIR_ENV,
+    summarize_new_events,
+)
 
 
 def _free_port() -> int:
@@ -77,7 +81,8 @@ def _free_port() -> int:
 
 def _spawn_group(argv, nproc: int, port: int,
                  devices_per_proc: int | None,
-                 heartbeat_dir: str | None = None) -> list[subprocess.Popen]:
+                 heartbeat_dir: str | None = None,
+                 telemetry_dir: str | None = None) -> list[subprocess.Popen]:
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
@@ -90,6 +95,8 @@ def _spawn_group(argv, nproc: int, port: int,
         })
         if heartbeat_dir is not None:
             env[HEARTBEAT_DIR_ENV] = heartbeat_dir
+        if telemetry_dir is not None:
+            env[TELEMETRY_DIR_ENV] = telemetry_dir
         if devices_per_proc is not None:
             from pytorchdistributed_tpu.runtime.launch import sim_device_flags
             env["JAX_PLATFORMS"] = "cpu"
@@ -134,6 +141,15 @@ def main(argv=None) -> int:
     parser.add_argument("--devices-per-proc", type=int, default=None,
                         help="CPU-sim chips per process (sets JAX_PLATFORMS="
                              "cpu + xla_force_host_platform_device_count)")
+    parser.add_argument("--telemetry-dir", type=str, default=None,
+                        help="run directory for the unified telemetry "
+                             "subsystem: exported to workers as "
+                             f"{TELEMETRY_DIR_ENV} (Trainers write spans/"
+                             "metrics/events per rank there) and the agent "
+                             "prints each incarnation's tripwire events "
+                             "next to its restart decisions; read back "
+                             "with `python -m pytorchdistributed_tpu."
+                             "telemetry report <dir>`")
     parser.add_argument("--elastic-min-nproc", type=int, default=0,
                         help="allow the group to relaunch SMALLER (down to "
                              "this size) when the same rank fails twice in "
@@ -155,6 +171,20 @@ def main(argv=None) -> int:
     restarts = 0
     nproc = args.nproc_per_node
     last_failed, consecutive = None, 0
+    if args.telemetry_dir is not None:
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+    # Per-incarnation telemetry aggregation: byte offsets into the
+    # per-rank event files advance as the agent reports, so each summary
+    # covers exactly the incarnation that just ended — the tripwire
+    # analog of the heartbeat state printed on the same stream.
+    tele_offsets: dict[str, int] = {}
+
+    def report_telemetry() -> None:
+        if args.telemetry_dir is None:
+            return
+        summary = summarize_new_events(args.telemetry_dir, tele_offsets)
+        if summary is not None:
+            print(f"[run] telemetry: {summary}", file=sys.stderr)
     if args.elastic_min_nproc > 0 and args.max_restarts < 1:
         print("[run] warning: --elastic-min-nproc needs --max-restarts >= 1 "
               "to observe a repeated failure; it will never fire",
@@ -167,7 +197,8 @@ def main(argv=None) -> int:
                   if args.heartbeat_timeout > 0 else None)
         spawned_at = time.time()
         procs = _spawn_group(worker_argv, nproc, port,
-                             args.devices_per_proc, hb_dir)
+                             args.devices_per_proc, hb_dir,
+                             args.telemetry_dir)
         failed, why = [], "failed"
         while not failed:
             time.sleep(args.monitor_interval)
@@ -179,6 +210,7 @@ def main(argv=None) -> int:
             elif all(c == 0 for c in codes):
                 if hb_dir is not None:
                     shutil.rmtree(hb_dir, ignore_errors=True)
+                report_telemetry()
                 return 0
             elif hb_dir is not None:
                 hung = stale_ranks(hb_dir, nproc,
@@ -206,6 +238,7 @@ def main(argv=None) -> int:
                 # whole group completed during the settle — success
                 if hb_dir is not None:
                     shutil.rmtree(hb_dir, ignore_errors=True)
+                report_telemetry()
                 return 0
             exited = [r for r, c in enumerate(codes)
                       if c not in (None, 0)]
@@ -226,6 +259,10 @@ def main(argv=None) -> int:
         # SIGTERM-ignoring worker, and that wait is not health either.
         detected_at = time.time()
         _kill_group(procs)
+        # aggregate this incarnation's tripwire events next to the
+        # failure attribution below (NaN storms and loss spikes are the
+        # why behind many a nonzero exit)
+        report_telemetry()
         # Healthy uptime of the incarnation that just failed (feeds the
         # regrow gate below). Clean exits: wall clock to detection —
         # lag is ~monitor-interval + the settle window. HUNG cohorts:

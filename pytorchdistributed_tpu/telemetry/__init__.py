@@ -1,0 +1,32 @@
+"""Unified telemetry subsystem (SURVEY.md §5, grown into a layer):
+
+  * spans.py      — host-span tracer (ring buffer → Chrome-trace JSON)
+  * accounting.py — StepAccounting: MFU / tokens-per-s / comm-bytes from
+                    the compiled step joined with wall-clock
+  * events.py     — anomaly tripwires → per-rank TelemetryEvent JSONL
+  * report.py     — the cross-rank run report CLI
+                    (``python -m pytorchdistributed_tpu.telemetry report``)
+
+The Trainer enables all of it with one knob (``telemetry_dir=...`` or the
+launcher's ``--telemetry-dir`` / PTD_TELEMETRY_DIR env).
+"""
+
+from pytorchdistributed_tpu.telemetry.accounting import (  # noqa: F401
+    CPU_SIM_NOMINAL_PEAK_FLOPS,
+    PEAK_BF16_FLOPS,
+    StepAccounting,
+    device_memory_highwater,
+    peak_flops_for,
+)
+from pytorchdistributed_tpu.telemetry.events import (  # noqa: F401
+    TELEMETRY_DIR_ENV,
+    AnomalyDetector,
+    EventLog,
+    TelemetryEvent,
+    read_events,
+    summarize_new_events,
+)
+from pytorchdistributed_tpu.telemetry.spans import (  # noqa: F401
+    SpanTracer,
+    merge_chrome_traces,
+)

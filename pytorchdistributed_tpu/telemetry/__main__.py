@@ -1,0 +1,45 @@
+"""CLI entry: ``python -m pytorchdistributed_tpu.telemetry <cmd>``.
+
+  report <run-dir>        merged cross-rank run report (see report.py)
+  merge-trace <run-dir>   merge every rank's host-span trace into one
+                          Chrome-trace JSON (open in ui.perfetto.dev;
+                          overlay the jax.profiler device capture by
+                          opening both)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pytorchdistributed_tpu.telemetry.report import render
+from pytorchdistributed_tpu.telemetry.spans import merge_chrome_traces
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("pytorchdistributed_tpu.telemetry")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="merged cross-rank run report")
+    rp.add_argument("run_dir")
+    rp.add_argument("--top", type=int, default=10,
+                    help="rows per top-N table")
+    mp = sub.add_parser("merge-trace",
+                        help="merge per-rank host-span traces")
+    mp.add_argument("run_dir")
+    mp.add_argument("-o", "--output", default=None,
+                    help="output path (default <run-dir>/merged.trace.json)")
+    args = p.parse_args(argv)
+    if args.cmd == "report":
+        print(render(args.run_dir, top=args.top))
+        return 0
+    out = args.output or f"{args.run_dir.rstrip('/')}/merged.trace.json"
+    merged = merge_chrome_traces(args.run_dir)
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    print(f"merged {len(merged['traceEvents'])} events into {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

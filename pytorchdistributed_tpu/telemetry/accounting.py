@@ -1,0 +1,192 @@
+"""Derived step metrics: turn wall-clock into MFU / tokens-per-s /
+comm-bytes — the join of compile-time facts with runtime timing.
+
+PR 1's HLO censuses (`utils/hlo.py`) already extract per-device flops and
+the collective mix from a compiled train step, and the Trainer times
+steps — but nobody joined the two, so the repo had no MFU or per-step
+communication-volume number outside a hand-run profiler session.
+`StepAccounting` is that join, built ONCE per (config, mesh, batch
+shape) from the AOT-compiled step (`Trainer.lower_step(...).compile()`):
+
+  * ``model_flops_per_step`` — XLA cost analysis, per device,
+    post-partitioning (the same number the compiled-invariant tripwires
+    pin, so an MFU-math regression trips in CI);
+  * ``comm_bytes_per_step`` — `utils.hlo.collective_bytes` over the
+    optimized HLO (collectives exist only post-SPMD-partitioning);
+  * ``peak_flops_per_device`` — per-TPU-generation bf16 peak, with a
+    NOMINAL CPU-sim fallback so the full metrics path runs (and is
+    testable) without a chip; ``peak_source`` labels which was used so a
+    sim MFU can never be mistaken for a hardware one.
+
+Everything downstream is arithmetic on a measured sec/step: `mfu()`,
+`tokens_per_s()`. The object is JSON-(de)serializable so rank 0 stamps
+it into the telemetry run dir and the report CLI re-derives the numbers
+offline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from pytorchdistributed_tpu.utils.hlo import collective_bytes
+
+# Peak bf16 matmul throughput per chip, by jax device_kind — the MFU
+# denominator (shared with bench.py; previously its private table).
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+# The CPU-sim stand-in peak: a NOMINAL 1 TFLOP/s so MFU is computable
+# (and deterministic in tests) on the 8-device simulator. The absolute
+# value is meaningless by construction — peak_source carries the label
+# so no report can pass a sim MFU off as utilization of real hardware.
+CPU_SIM_NOMINAL_PEAK_FLOPS = 1e12
+
+
+def peak_flops_for(device_kind: str,
+                   platform: str | None = None) -> tuple[float | None, str]:
+    """(per-device peak bf16 flops, source label). Unknown TPU kinds get
+    (None, "unknown:<kind>") — better to omit MFU than to invent a
+    denominator for a chip generation this table predates."""
+    peak = PEAK_BF16_FLOPS.get(device_kind)
+    if peak is not None:
+        return peak, device_kind
+    if platform == "cpu" or device_kind == "cpu":
+        return CPU_SIM_NOMINAL_PEAK_FLOPS, "cpu-sim-nominal"
+    return None, f"unknown:{device_kind}"
+
+
+def device_memory_highwater() -> int | None:
+    """Max per-device HBM high-water (bytes) over the local devices, via
+    ``device.memory_stats()`` — None where the backend has none (the CPU
+    sim reports no stats). A host-side read of allocator counters: no
+    device sync, cheap enough for log cadence."""
+    import jax
+
+    peak = None
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return None
+        if not stats:
+            continue
+        v = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if v is not None:
+            peak = max(peak or 0, int(v))
+    return peak
+
+
+@dataclasses.dataclass(frozen=True)
+class StepAccounting:
+    """Compile-time facts of one train step, ready to join with wall-clock.
+
+    ``model_flops_per_step`` and ``comm_bytes_per_step`` are PER DEVICE
+    (post-partitioning, matching the compiled-invariant convention);
+    ``tokens_per_step`` / ``samples_per_step`` are GLOBAL (the batch the
+    step consumes), so ``tokens_per_s`` reports global throughput."""
+
+    model_flops_per_step: float
+    comm_bytes_per_step: int
+    comm_bytes_by_op: dict[str, int]
+    tokens_per_step: int
+    samples_per_step: int
+    peak_flops_per_device: float | None
+    peak_source: str
+    n_devices: int
+
+    @classmethod
+    def from_compiled(cls, compiled, *, batch, n_devices: int | None = None,
+                      ) -> "StepAccounting":
+        """Build from a `jax.stages.Compiled` train step (the output of
+        `Trainer.lower_step(batch).compile()`) plus the batch that shaped
+        it. ``batch`` may be arrays or ShapeDtypeStructs — only shapes
+        are read."""
+        import jax
+
+        cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):  # older jax wraps in a list
+            cost = cost[0] if cost else {}
+        by_op = collective_bytes(compiled.as_text())
+        tokens, samples = _batch_tokens_samples(batch)
+        dev = jax.devices()[0]
+        peak, source = peak_flops_for(dev.device_kind, dev.platform)
+        return cls(
+            model_flops_per_step=float(cost.get("flops", 0.0)),
+            comm_bytes_per_step=int(sum(by_op.values())),
+            comm_bytes_by_op=by_op,
+            tokens_per_step=tokens,
+            samples_per_step=samples,
+            peak_flops_per_device=peak,
+            peak_source=source,
+            n_devices=(n_devices if n_devices is not None
+                       else jax.device_count()),
+        )
+
+    # -- derived metrics ---------------------------------------------------
+
+    def mfu(self, sec_per_step: float) -> float | None:
+        """Model-flops utilization of ONE device: cost-analysis flops are
+        already per-device, so no world-size factor enters."""
+        if (self.peak_flops_per_device is None or sec_per_step <= 0
+                or self.model_flops_per_step <= 0):
+            return None
+        return round(self.model_flops_per_step / sec_per_step
+                     / self.peak_flops_per_device, 4)
+
+    def tokens_per_s(self, sec_per_step: float) -> float | None:
+        if sec_per_step <= 0:
+            return None
+        return round(self.tokens_per_step / sec_per_step, 1)
+
+    def comm_bytes_per_s(self, sec_per_step: float) -> float | None:
+        if sec_per_step <= 0:
+            return None
+        return round(self.comm_bytes_per_step / sec_per_step, 1)
+
+    # -- (de)serialization -------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({"format": 1, **dataclasses.asdict(self)})
+
+    @classmethod
+    def from_json(cls, text: str) -> "StepAccounting":
+        d = json.loads(text)
+        d.pop("format", None)
+        return cls(**d)
+
+    def save(self, path: str | os.PathLike) -> None:
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str | os.PathLike) -> "StepAccounting":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+def _batch_tokens_samples(batch) -> tuple[int, int]:
+    """(global tokens, global samples) from batch leaf shapes. LM batches
+    carry a 2-D "tokens" leaf → tokens = B·S; everything else counts the
+    leading dim (one "token" per sample, matching how samples/s and
+    tokens/s coincide for vision workloads)."""
+    shapes = {k: tuple(getattr(v, "shape", ()))
+              for k, v in dict(batch).items()}
+    samples = next((s[0] for s in shapes.values() if s), 0)
+    tok = shapes.get("tokens")
+    if tok is not None and len(tok) >= 2:
+        n = 1
+        for d in tok:
+            n *= int(d)
+        return n, int(samples)
+    return int(samples), int(samples)

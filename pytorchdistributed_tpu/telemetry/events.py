@@ -1,0 +1,227 @@
+"""Anomaly tripwires + structured telemetry events.
+
+The NaN watchdog (utils/guards.py) RAISES on a non-finite metric — right
+for halting, useless for post-mortem: the exception dies with the rank
+and nothing durable says which step, which metric, what the loss was
+doing beforehand. Tripwires here are the recording half: evaluated at
+log cadence (piggybacking on the device sync the Trainer already pays
+for — no extra blocking), they emit `TelemetryEvent` JSONL records,
+one file per rank, that survive the process. The launcher
+(`pytorchdistributed_tpu.run --telemetry-dir`) aggregates them per
+incarnation next to its heartbeat state, and the report CLI folds them
+into the run report.
+
+Detectors:
+  * non-finite: any logged metric (loss, grad_norm, ...) NaN/Inf;
+  * loss spike: EMA z-score — an EMA mean/variance of the loss, an
+    event when a new value sits more than ``z_threshold`` deviations
+    above the mean (one-sided: dropping fast is not an anomaly). The
+    EMA warmup suppresses the first noisy observations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import math
+import os
+import time
+
+TELEMETRY_DIR_ENV = "PTD_TELEMETRY_DIR"
+
+# The run-dir file contract, shared by writer (Trainer) and readers
+# (report CLI, the run.py agent) — rename in ONE place or readers
+# silently find nothing.
+EVENTS_FILE = "events_rank{rank}.jsonl"
+EVENTS_GLOB = "events_rank*.jsonl"
+METRICS_FILE = "metrics_rank{rank}.jsonl"
+METRICS_GLOB = "metrics_rank*.jsonl"
+
+
+class JsonlWriter:
+    """Append-only JSONL sink. Lazy (re)open in append mode — safe to
+    ``close()`` at every epoch teardown and keep writing next epoch —
+    and line-buffered, so each row is durable even if the process dies
+    mid-epoch and the file is never left open or truncated. Zero-dep on
+    purpose: the one durability implementation behind both the Trainer's
+    metric sinks (training/logging.py re-exports it) and EventLog."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = str(path)
+        self._f = None
+
+    def write(self, obj: dict) -> None:
+        self.write_line(json.dumps(obj))
+
+    def write_line(self, line: str) -> None:
+        if self._f is None:
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+        self._f.write(line + "\n")
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryEvent:
+    """One structured anomaly/lifecycle record (a JSONL row)."""
+
+    kind: str
+    step: int
+    rank: int
+    time: float
+    data: dict
+
+    def to_json(self) -> str:
+        return json.dumps({"kind": self.kind, "step": self.step,
+                           "rank": self.rank, "time": self.time,
+                           **self.data})
+
+    @classmethod
+    def from_json(cls, line: str) -> "TelemetryEvent":
+        d = json.loads(line)
+        return cls(kind=d.pop("kind"), step=int(d.pop("step", -1)),
+                   rank=int(d.pop("rank", 0)), time=float(d.pop("time", 0.0)),
+                   data=d)
+
+    def describe(self) -> str:
+        extras = " ".join(f"{k}={v}" for k, v in self.data.items())
+        return f"rank {self.rank} step {self.step} {self.kind} {extras}"
+
+
+class EventLog(JsonlWriter):
+    """Per-rank TelemetryEvent sink: a JsonlWriter that stamps
+    rank/time and returns the structured event from emit()."""
+
+    def __init__(self, path: str | os.PathLike, rank: int = 0):
+        super().__init__(path)
+        self.rank = rank
+
+    @classmethod
+    def from_env(cls, rank: int) -> "EventLog | None":
+        d = os.environ.get(TELEMETRY_DIR_ENV)
+        if not d:
+            return None
+        os.makedirs(d, exist_ok=True)
+        return cls(os.path.join(d, EVENTS_FILE.format(rank=rank)),
+                   rank=rank)
+
+    def emit(self, kind: str, *, step: int, **data) -> TelemetryEvent:
+        ev = TelemetryEvent(kind=kind, step=step, rank=self.rank,
+                            time=round(time.time(), 3), data=data)
+        self.write_line(ev.to_json())
+        return ev
+
+
+class AnomalyDetector:
+    """The tripwire logic, pure host arithmetic on already-synced metric
+    floats — `check` adds no device work. Returns (kind, payload) pairs;
+    the caller (Trainer) turns them into EventLog records."""
+
+    def __init__(self, *, loss_key: str = "loss", z_threshold: float = 6.0,
+                 ema: float = 0.98, warmup: int = 5,
+                 min_rel_std: float = 0.05):
+        self.loss_key = loss_key
+        self.z_threshold = z_threshold
+        self.ema = ema
+        self.warmup = warmup
+        # std floor as a fraction of the EMA mean: a smoothly-converging
+        # loss drives the EMA variance toward zero, where any drift would
+        # z-score as a "spike" — only excursions that are also material
+        # relative to the loss level should trip
+        self.min_rel_std = min_rel_std
+        self._mean = 0.0
+        self._var = 0.0
+        self._seen = 0
+
+    def check(self, metrics: dict[str, float],
+              step: int) -> list[tuple[str, dict]]:
+        out: list[tuple[str, dict]] = []
+        for k, v in metrics.items():
+            v = float(v)
+            if not math.isfinite(v):
+                out.append(("non_finite_metric",
+                            {"metric": k, "value": str(v)}))
+        loss = metrics.get(self.loss_key)
+        if loss is not None and math.isfinite(float(loss)):
+            loss = float(loss)
+            if self._seen >= self.warmup:
+                std = max(math.sqrt(max(self._var, 0.0)),
+                          self.min_rel_std * abs(self._mean), 1e-8)
+                z = (loss - self._mean) / std
+                if z > self.z_threshold:
+                    out.append(("loss_spike", {
+                        "value": round(loss, 6),
+                        "ema_mean": round(self._mean, 6),
+                        "ema_std": round(std, 6), "z": round(z, 2)}))
+            # fold AFTER judging: the spike itself must not pre-inflate
+            # the variance it is measured against
+            m = self.ema if self._seen else 0.0
+            delta = loss - self._mean
+            self._mean += (1 - m) * delta
+            self._var = m * (self._var + (1 - m) * delta * delta)
+            self._seen += 1
+        return out
+
+
+def read_events(run_dir: str | os.PathLike) -> list[TelemetryEvent]:
+    """Every TelemetryEvent under ``run_dir`` (all ranks, sorted by
+    time) — the report CLI's reader."""
+    events: list[TelemetryEvent] = []
+    for path in sorted(glob.glob(os.path.join(str(run_dir), EVENTS_GLOB))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(TelemetryEvent.from_json(line))
+                    except (json.JSONDecodeError, KeyError):
+                        continue  # torn final line of a killed rank
+    return sorted(events, key=lambda e: e.time)
+
+
+def summarize_new_events(run_dir: str | os.PathLike,
+                         offsets: dict[str, int]) -> str | None:
+    """Agent-side per-incarnation aggregation: counts of event kinds per
+    rank appended past ``offsets`` (byte offsets per file, updated in
+    place — call once per incarnation teardown). None when nothing new."""
+    counts: dict[tuple[int, str], int] = {}
+    for path in sorted(glob.glob(os.path.join(str(run_dir), EVENTS_GLOB))):
+        start = offsets.get(path, 0)
+        try:
+            with open(path) as f:
+                f.seek(start)
+                chunk = f.read()
+                offsets[path] = f.tell()
+        except OSError:
+            continue
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = TelemetryEvent.from_json(line)
+            except (json.JSONDecodeError, KeyError):
+                continue
+            counts[(ev.rank, ev.kind)] = counts.get((ev.rank, ev.kind), 0) + 1
+    if not counts:
+        return None
+    parts = [f"rank {r} {kind} x{n}"
+             for (r, kind), n in sorted(counts.items())]
+    return f"{sum(counts.values())} event(s): " + ", ".join(parts)

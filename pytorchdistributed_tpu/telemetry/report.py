@@ -1,0 +1,251 @@
+"""The run report: one terminal answer to "how did that run go?".
+
+    python -m pytorchdistributed_tpu.telemetry report <run-dir>
+
+Merges everything a telemetry-enabled run (`Trainer(telemetry_dir=...)`
+or `run.py --telemetry-dir`) leaves behind in one directory:
+
+  * ``metrics_rank*.jsonl``  — per-rank step metrics (loss, samples/s,
+    step time, tokens/s, MFU, comm-bytes/step at log cadence);
+  * ``spans_rank*.trace.json`` — host-span traces (where host time went);
+  * ``events_rank*.jsonl``   — anomaly tripwire events;
+  * ``accounting.json``      — the StepAccounting compile-time facts;
+  * a `jax.profiler` capture under the dir (``plugins/profile/...``), if
+    the run pointed ``profile_dir`` into it — summarized via
+    utils/trace.py with auto-detected step count.
+
+Pure stdlib + the repo's own readers; no device work or backend init, so
+the report runs on a machine that never touched the job (copy the run
+dir home, read it there).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from pytorchdistributed_tpu.telemetry.accounting import StepAccounting
+from pytorchdistributed_tpu.telemetry.events import (
+    METRICS_GLOB,
+    read_events,
+)
+from pytorchdistributed_tpu.telemetry.spans import SPAN_TRACE_GLOB
+
+ACCOUNTING_FILE = "accounting.json"
+
+
+def _fmt_bytes(n: float | int | None) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _read_metric_rows(run_dir: str) -> dict[int, list[dict]]:
+    rows: dict[int, list[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, METRICS_GLOB))):
+        base = os.path.basename(path)
+        try:
+            rank = int(base[len("metrics_rank"):-len(".jsonl")])
+        except ValueError:
+            continue
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn final line
+        rows[rank] = out
+    return rows
+
+
+def _mean_of(rows: list[dict], key: str) -> float | None:
+    vals = [float(r[key]) for r in rows if key in r
+            and isinstance(r[key], (int, float))]
+    vals = [v for v in vals if v == v]  # drop NaN
+    return sum(vals) / len(vals) if vals else None
+
+
+def _derive_step_time(rows: list[dict]) -> float | None:
+    """Fallback when rows carry no step_time_s (no accounting): wall time
+    between logged rows over the steps covered. Step numbers reset each
+    epoch, so the count accumulates per consecutive pair — an epoch
+    rollover contributes the new epoch's step offset (the unlogged tail
+    of the previous epoch, at most log_every-1 steps, is approximated
+    away rather than inflating the result)."""
+    direct = _mean_of(rows, "step_time_s")
+    if direct is not None:
+        return direct
+    pts = [(r["time"], r.get("epoch", 0), r["step"]) for r in rows
+           if "time" in r and "step" in r]
+    if len(pts) < 2:
+        return None
+    steps = 0
+    for (_, e0, s0), (_, e1, s1) in zip(pts, pts[1:]):
+        steps += (s1 - s0) if e1 == e0 else s1
+    dt = pts[-1][0] - pts[0][0]
+    return dt / steps if steps > 0 and dt > 0 else None
+
+
+def _read_span_totals(run_dir: str) -> dict[int, dict[str, tuple[float, int]]]:
+    """{rank: {span name: (total ms, count)}} from the dumped traces."""
+    out: dict[int, dict[str, tuple[float, int]]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, SPAN_TRACE_GLOB))):
+        base = os.path.basename(path)
+        try:
+            rank = int(base[len("spans_rank"):-len(".trace.json")])
+        except ValueError:
+            continue
+        try:
+            with open(path) as f:
+                events = json.load(f).get("traceEvents", [])
+        except (OSError, json.JSONDecodeError):
+            continue
+        totals: dict[str, list] = {}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            r = totals.setdefault(e["name"], [0.0, 0])
+            r[0] += e.get("dur", 0) / 1e3  # µs -> ms
+            r[1] += 1
+        out[rank] = {k: (v[0], v[1]) for k, v in totals.items()}
+    return out
+
+
+def _device_trace_section(run_dir: str, top: int) -> list[str]:
+    if not glob.glob(os.path.join(run_dir, "**", "*.trace.json.gz"),
+                     recursive=True):
+        return ["device trace: none found (point Trainer(profile_dir=...) "
+                "into the run dir to include one)"]
+    # imported lazily: summarize is the one reader that may pull heavier
+    # deps, and most run dirs carry no capture
+    from pytorchdistributed_tpu.utils.trace import summarize
+
+    try:
+        return ["device trace summary (utils/trace.py):",
+                summarize(run_dir, steps=None, top=top)]
+    except Exception as e:
+        return [f"device trace: unreadable ({e})"]
+
+
+def render(run_dir: str | os.PathLike, *, top: int = 10) -> str:
+    """The merged cross-rank run report as one printable string."""
+    run_dir = str(run_dir)
+    rows_by_rank = _read_metric_rows(run_dir)
+    events = read_events(run_dir)
+    span_totals = _read_span_totals(run_dir)
+    acct = None
+    acct_path = os.path.join(run_dir, ACCOUNTING_FILE)
+    if os.path.exists(acct_path):
+        try:
+            acct = StepAccounting.load(acct_path)
+        except Exception:
+            pass
+
+    lines = [f"telemetry run report: {run_dir}"]
+    ranks = sorted(set(rows_by_rank) | set(span_totals)
+                   | {e.rank for e in events})
+    lines.append(f"ranks: {', '.join(map(str, ranks)) if ranks else 'none'}")
+    lines.append("")
+
+    # -- step accounting (compile-time facts) ------------------------------
+    if acct is not None:
+        sim = " (sim fallback)" if acct.peak_source == "cpu-sim-nominal" \
+            else f" ({acct.peak_source})"
+        lines.append("step accounting (per device, from the compiled step):")
+        lines.append(f"  model flops/step: {acct.model_flops_per_step:.4g}")
+        lines.append(f"  comm bytes/step:  "
+                     f"{_fmt_bytes(acct.comm_bytes_per_step)}  "
+                     + " ".join(f"{k}={_fmt_bytes(v)}"
+                                for k, v in acct.comm_bytes_by_op.items()
+                                if v))
+        peak = (f"{acct.peak_flops_per_device:.4g}"
+                if acct.peak_flops_per_device else "unknown")
+        lines.append(f"  peak flops/device: {peak}{sim}  |  "
+                     f"devices: {acct.n_devices}  |  global tokens/step: "
+                     f"{acct.tokens_per_step}")
+    else:
+        lines.append("step accounting: no accounting.json "
+                     "(run with Trainer(telemetry_dir=...))")
+    lines.append("")
+
+    # -- per-rank merged metrics -------------------------------------------
+    lines.append(f"{'rank':>4}  {'steps':>5}  {'last':>5}  "
+                 f"{'step time':>10}  {'tokens/s':>10}  {'mfu':>7}  "
+                 f"{'comm/step':>10}  {'loss(last)':>10}  {'events':>6}")
+    n_events_by_rank = {r: sum(1 for e in events if e.rank == r)
+                        for r in ranks}
+    for rank in ranks:
+        rows = rows_by_rank.get(rank, [])
+        step_time = _derive_step_time(rows)
+        tokens_s = _mean_of(rows, "tokens_per_s")
+        tokens_note = ""
+        if tokens_s is None and acct is not None and step_time:
+            tokens_s = acct.tokens_per_s(step_time)
+        if tokens_s is None:
+            # last resort is SAMPLES/s (no accounting to convert with) —
+            # label it, or an LM run would misread by a factor of seq_len
+            tokens_s = _mean_of(rows, "samples_per_s")
+            if tokens_s is not None:
+                tokens_note = " smp"
+        mfu = _mean_of(rows, "mfu")
+        if mfu is None and acct is not None and step_time:
+            mfu = acct.mfu(step_time)
+        comm = _mean_of(rows, "comm_bytes_per_step")
+        if comm is None and acct is not None:
+            comm = acct.comm_bytes_per_step
+        last_loss = next((float(r["loss"]) for r in reversed(rows)
+                          if "loss" in r), None)
+        mfu_s = f"{mfu:.4f}" if mfu is not None else "-"
+        if mfu is not None and acct is not None \
+                and acct.peak_source == "cpu-sim-nominal":
+            mfu_s += "*"
+        step_s = f"{step_time * 1e3:.1f} ms" if step_time else "-"
+        tok_s = (f"{tokens_s:.1f}{tokens_note}"
+                 if tokens_s is not None else "-")
+        loss_s = f"{last_loss:.4g}" if last_loss is not None else "-"
+        lines.append(
+            f"{rank:>4}  {len(rows):>5}  "
+            f"{(rows[-1]['step'] if rows else '-'):>5}  "
+            f"{step_s:>10}  {tok_s:>10}  "
+            f"{mfu_s:>7}  {_fmt_bytes(comm):>10}  "
+            f"{loss_s:>10}  "
+            f"{n_events_by_rank.get(rank, 0):>6}")
+    if acct is not None and acct.peak_source == "cpu-sim-nominal":
+        lines.append("  (* MFU against the CPU-sim NOMINAL peak — not a "
+                     "hardware utilization number)")
+    lines.append("")
+
+    # -- tripwire events ----------------------------------------------------
+    if events:
+        lines.append(f"tripwire events ({len(events)}):")
+        for e in events[:50]:
+            lines.append(f"  {e.describe()}")
+        if len(events) > 50:
+            lines.append(f"  ... and {len(events) - 50} more")
+    else:
+        lines.append("tripwire events: none")
+    lines.append("")
+
+    # -- host spans ----------------------------------------------------------
+    if span_totals:
+        lines.append("host spans (total ms / count):")
+        for rank in sorted(span_totals):
+            totals = span_totals[rank]
+            ordered = sorted(totals.items(), key=lambda kv: -kv[1][0])[:top]
+            lines.append(f"  rank {rank}: " + "  ".join(
+                f"{name} {ms:.1f}/{n}" for name, (ms, n) in ordered))
+    else:
+        lines.append("host spans: none recorded")
+    lines.append("")
+
+    lines.extend(_device_trace_section(run_dir, top))
+    return "\n".join(lines)

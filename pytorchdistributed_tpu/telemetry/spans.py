@@ -1,0 +1,131 @@
+"""Host-span tracing — the host half of "where did the step go?".
+
+`jax.profiler` answers for the *device* (utils/trace.py summarizes its
+captures); nothing answered for the *host*: data loading, H2D sharding,
+dispatch, the blocking metric sync, checkpoint saves. `SpanTracer` is a
+zero-dependency ring-buffer recorder the Trainer wraps around exactly
+those regions. Design constraints, in order:
+
+  * **Overhead**: entering+exiting a span is two `perf_counter_ns` calls
+    and one deque append (~1-2 µs measured — tests/test_telemetry.py pins
+    the budget). Cheap enough to leave on for a whole run; the ring
+    buffer (`capacity` spans, oldest evicted) bounds memory for
+    arbitrarily long jobs.
+  * **Chrome-trace output**: `dump()` writes the Trace Event JSON format,
+    one file per rank, `pid` = rank — openable directly in
+    ui.perfetto.dev / chrome://tracing, and mergeable across ranks
+    (`merge_chrome_traces`). Timestamps are unix-epoch microseconds
+    (wall-clock anchored once at tracer construction, monotonic within
+    the trace), so independently-dumped ranks land on one timeline.
+  * **Zero deps**: no jax import — the tracer must be constructible
+    before any backend init and usable from launcher-side code.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import time
+
+
+class _Span:
+    """One `with tracer.span(name):` region. Allocation-light on purpose:
+    the hot loop enters several of these per step."""
+
+    __slots__ = ("_buf", "_name", "_t0")
+
+    def __init__(self, buf, name):
+        self._buf = buf
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._buf.append((self._name, self._t0, time.perf_counter_ns()))
+        return False
+
+
+class SpanTracer:
+    """Ring-buffer host-span recorder; one instance per process/rank.
+
+    ``rank`` stamps the Chrome-trace pid (defaults to the launcher env
+    contract's RANK, 0 outside one); ``capacity`` bounds memory — at 6
+    spans/step the default holds ~10k steps of history.
+    """
+
+    def __init__(self, capacity: int = 65536, rank: int | None = None):
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("RANK", "0")))
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        # One-time wall-clock anchor: spans record monotonic perf_counter
+        # times; the anchor maps them onto unix-epoch µs so traces dumped
+        # by different ranks (different processes, same or different
+        # hosts) merge onto a shared timeline.
+        self._epoch_us = time.time() * 1e6 - time.perf_counter_ns() / 1e3
+
+    def span(self, name: str) -> _Span:
+        return _Span(self._buf, name)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def totals(self) -> dict[str, tuple[float, int]]:
+        """{span name: (total ms, count)} over the buffered spans."""
+        out: dict[str, list] = {}
+        for name, t0, t1 in self._buf:
+            r = out.setdefault(name, [0.0, 0])
+            r[0] += (t1 - t0) / 1e6
+            r[1] += 1
+        return {k: (v[0], v[1]) for k, v in out.items()}
+
+    def to_chrome_trace(self) -> dict:
+        """Trace Event JSON dict: complete ("X") events, ts/dur in µs."""
+        pid = self.rank
+        events: list[dict] = [
+            {"ph": "M", "name": "process_name", "pid": pid,
+             "args": {"name": f"host rank {self.rank}"}},
+            {"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
+             "args": {"name": "host spans"}},
+        ]
+        for name, t0, t1 in self._buf:
+            events.append({
+                "ph": "X", "name": name, "pid": pid, "tid": 0,
+                "ts": round(self._epoch_us + t0 / 1e3, 3),
+                "dur": round((t1 - t0) / 1e3, 3),
+                "cat": "host",
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump(self, path: str | os.PathLike) -> None:
+        """Write the Chrome-trace JSON (atomic rename: a reader — the
+        report CLI, a mid-run Perfetto open — never sees a torn file)."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+
+
+# writer filename / reader glob pair — rename together (report.py and
+# the Trainer both import these; see the matching contract in events.py)
+SPAN_TRACE_FILE = "spans_rank{rank}.trace.json"
+SPAN_TRACE_GLOB = "spans_rank*.trace.json"
+
+
+def merge_chrome_traces(run_dir: str | os.PathLike,
+                        extra_events: list[dict] | None = None) -> dict:
+    """Merge every rank's span trace under ``run_dir`` into one
+    Chrome-trace dict (each file already carries a distinct pid = rank).
+    ``extra_events`` lets a caller overlay another trace's events — e.g.
+    the device events of a `jax.profiler` capture — on the same timeline."""
+    events: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(str(run_dir),
+                                              SPAN_TRACE_GLOB))):
+        with open(path) as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    if extra_events:
+        events.extend(extra_events)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -18,9 +18,12 @@ SPMD train step:
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import time
 from functools import partial
+from pathlib import Path
 from typing import Any, Callable
 
 import flax.linen as nn
@@ -38,7 +41,16 @@ from pytorchdistributed_tpu.runtime import dist
 from pytorchdistributed_tpu.runtime.heartbeat import Heartbeat
 from pytorchdistributed_tpu.data.loader import shard_batch
 from pytorchdistributed_tpu.runtime.mesh import batch_leaf_sharding, create_mesh
-from pytorchdistributed_tpu.training.logging import MetricLogger
+from pytorchdistributed_tpu.telemetry import (
+    TELEMETRY_DIR_ENV,
+    AnomalyDetector,
+    EventLog,
+    SpanTracer,
+    device_memory_highwater,
+)
+from pytorchdistributed_tpu.telemetry.events import EVENTS_FILE, METRICS_FILE
+from pytorchdistributed_tpu.telemetry.spans import SPAN_TRACE_FILE
+from pytorchdistributed_tpu.training.logging import JsonlWriter, MetricLogger
 from pytorchdistributed_tpu.utils.guards import (
     NaNWatchdog,
     assert_replicas_consistent,
@@ -136,6 +148,11 @@ class Trainer:
     compile options, merged OVER the TPU backend defaults
     (_TPU_COMPILER_OPTIONS — scoped-VMEM headroom for the flash backward
     at long sequence); override a default by setting its key explicitly.
+    ``telemetry_dir`` (or the launcher's PTD_TELEMETRY_DIR) enables the
+    unified telemetry subsystem: host-span tracing around the loop's
+    phases, per-rank metric JSONL with MFU/comm-bytes from StepAccounting,
+    and anomaly-tripwire events — read it all back with
+    ``python -m pytorchdistributed_tpu.telemetry report <dir>``.
     """
 
     def __init__(
@@ -157,6 +174,7 @@ class Trainer:
         accum_steps: int = 1,
         metrics_file: str | None = None,
         compiler_options: dict[str, str] | None = None,
+        telemetry_dir: str | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -192,6 +210,39 @@ class Trainer:
         # (SURVEY.md §5), one durable line per logged step
         self.logger = MetricLogger(
             jsonl_path=metrics_file if dist.is_main_process() else None)
+        # Unified telemetry (telemetry/): span tracer + anomaly tripwires
+        # + per-rank metric JSONL + StepAccounting, all keyed off one run
+        # directory — the explicit arg, or the launcher's env contract
+        # (run.py --telemetry-dir exports PTD_TELEMETRY_DIR so workers
+        # opt in without code changes). Off (all None) when neither is
+        # set: the hot loop then pays only a handful of `is None` checks.
+        tdir = telemetry_dir or os.environ.get(TELEMETRY_DIR_ENV)
+        self.telemetry_dir = Path(tdir) if tdir else None
+        self._tracer = None
+        self._events = None
+        self._anomaly = None
+        self._telemetry_jsonl = None
+        self.accounting = None
+        # process_index when jax.distributed is up; otherwise the
+        # launcher env contract's RANK (a run.py worker that hasn't — or
+        # won't — init the process group must still get distinct
+        # per-rank telemetry files, not clobber rank 0's)
+        self._telemetry_rank = (
+            jax.process_index() if jax.process_count() > 1
+            else int(os.environ.get("RANK", "0")))
+        if self.telemetry_dir is not None:
+            self.telemetry_dir.mkdir(parents=True, exist_ok=True)
+            rank = self._telemetry_rank
+            self._tracer = SpanTracer(rank=rank)
+            self._events = EventLog(
+                self.telemetry_dir / EVENTS_FILE.format(rank=rank),
+                rank=rank)
+            self._anomaly = AnomalyDetector()
+            self._telemetry_jsonl = JsonlWriter(
+                self.telemetry_dir / METRICS_FILE.format(rank=rank))
+        self._dispatch_shapes: set = set()
+        self._accounting_attempted = False
+        self._last_batch_samples = 0
         self._loss_fn = loss_fn
         self._batch_adapter = batch_adapter or default_batch_adapter
         self._steps_per_epoch: int | None = None
@@ -249,13 +300,66 @@ class Trainer:
 
         rng = jax.random.key(seed)
         self._prepare_abstract(sample_batch, rng)
-        with jax.set_mesh(self.mesh):
+        with self._span("init_state"), jax.set_mesh(self.mesh):
             self.state = jax.jit(
                 make_state, out_shardings=self.state_shardings,
                 compiler_options=self._compiler_options,
             )(rng, sample_batch)
         self._step_fn = self._build_step()
+        self._maybe_build_accounting(sample_batch)
         return self.state
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _span(self, name: str):
+        """A host span when telemetry is on, else a nullcontext — the
+        single gate every instrumented region goes through."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name)
+
+    def step_accounting(self, sample_batch):
+        """`telemetry.StepAccounting` for THIS trainer's step at this
+        batch shape: AOT-lower + compile (`lower_step`) and read the
+        executable's cost analysis and collective-bytes census. Note this
+        compiles a second executable alongside the jit path's — cheap
+        under a persistent compile cache, a real extra compile without
+        one; telemetry-enabled runs opt into that once per run."""
+        from pytorchdistributed_tpu.telemetry import StepAccounting
+
+        compiled = self.lower_step(sample_batch).compile()
+        return StepAccounting.from_compiled(
+            compiled, batch=sample_batch, n_devices=self.mesh.devices.size)
+
+    def _maybe_build_accounting(self, sample_batch) -> None:
+        """With telemetry on, build StepAccounting once and stamp it into
+        the run dir (rank 0). Failure is non-fatal AND one-shot: a
+        backend where the build raises must pay the attempt (an AOT
+        compile) once, not once per step — accounting is derived
+        observability and must never drag down the job it observes."""
+        if (self.telemetry_dir is None or self.accounting is not None
+                or self._accounting_attempted):
+            return
+        self._accounting_attempted = True
+        try:
+            with self._span("step_accounting"):
+                self.accounting = self.step_accounting(sample_batch)
+            if dist.is_main_process():
+                self.accounting.save(self.telemetry_dir / "accounting.json")
+        except Exception as e:  # pragma: no cover - depends on backend
+            self.logger.info(f"telemetry: step accounting unavailable ({e})")
+
+    def _teardown_telemetry(self) -> None:
+        """Epoch-boundary (and exception-path) durability: flush/close
+        every telemetry sink and dump the span trace. Everything here
+        reopens lazily, so multi-epoch fits keep appending."""
+        if self.telemetry_dir is None:
+            return
+        self._tracer.dump(
+            self.telemetry_dir
+            / SPAN_TRACE_FILE.format(rank=self._telemetry_rank))
+        self._events.close()
+        self._telemetry_jsonl.close()
 
     def lower_step(self, sample_batch, seed: int = 0):
         """AOT-lower the jitted train step from ABSTRACT state: no params
@@ -594,8 +698,21 @@ class Trainer:
         if self._step_fn is None:  # state came from restore(), not init()
             self._step_fn = self._build_step()
         if any(not isinstance(v, jax.Array) for v in batch.values()):
-            batch = shard_batch(batch, self.batch_sharding)
-        with jax.set_mesh(self.mesh):
+            with self._span("h2d_transfer"):
+                batch = shard_batch(batch, self.batch_sharding)
+        # a dispatch of a batch-shape signature not seen before carries
+        # an XLA (re)compile — name it so host traces separate compile
+        # stalls from steady-state dispatch (e.g. a ragged final batch
+        # recompiling mid-epoch); the key is only built when tracing
+        name = "step_dispatch"
+        if self._tracer is not None:
+            key = tuple(sorted(
+                (k, tuple(getattr(v, "shape", ()))) for k, v in
+                batch.items()))
+            if key not in self._dispatch_shapes:
+                self._dispatch_shapes.add(key)
+                name = "compile_and_dispatch"
+        with self._span(name), jax.set_mesh(self.mesh):
             self.state, metrics = self._step_fn(self.state, batch)
         self._bound_dispatch_queue(metrics)
         return metrics
@@ -627,32 +744,120 @@ class Trainer:
         raw = iter(loader)
         for _ in range(skip_steps):  # already trained before the restart
             next(raw, None)
-        it = prefetch_to_device(raw, self.batch_sharding)
-        for i, batch in enumerate(it, start=skip_steps):
-            if self.state is None:
-                self.init(batch)
-            self._maybe_profile(epoch, i)
-            metrics = self.train_step(batch)
-            self._meter.update(self._batch_samples(batch))
-            if (i + 1) % self.log_every == 0:
-                vals = {k: float(v) for k, v in metrics.items()}
-                if self._heartbeat is not None:  # we just synced the device
-                    self._heartbeat.beat()
-                if self._watchdog is not None:
-                    self._watchdog.check(vals, self.state)
-                rate = self._meter.rate
-                if rate == rate:  # skip the warmup NaN
-                    vals["samples_per_s"] = rate
-                if dist.is_main_process():
-                    self.logger.log_step(epoch, i + 1, vals)
-            if (self.checkpoint is not None and self._checkpoint_every > 0
-                    and (i + 1) % self._checkpoint_every == 0):
-                self._save_checkpoint()
-        self._maybe_profile(epoch, -1)  # close an open capture at epoch end
+        if self._tracer is not None:
+            raw = self._spanned_iter(raw)
+        it = prefetch_to_device(raw, self.batch_sharding,
+                                tracer=self._tracer)
+        try:
+            for i, batch in enumerate(it, start=skip_steps):
+                if self.state is None:
+                    self.init(batch)
+                else:
+                    # no-op when already built (init did it) or telemetry
+                    # is off — this covers states that arrived via
+                    # restore(): a resumed incarnation must not lose the
+                    # derived metrics exactly on the runs telemetry is
+                    # meant to post-mortem
+                    self._maybe_build_accounting(batch)
+                self._maybe_profile(epoch, i)
+                if self._profiling:
+                    # step annotations ride the capture so utils/trace.py
+                    # can auto-detect the step count (no more --steps=1
+                    # mislabeling a 6-step window); the name is the shared
+                    # contract detect_step_count matches on
+                    from pytorchdistributed_tpu.utils.trace import (
+                        STEP_ANNOTATION,
+                    )
+
+                    with jax.profiler.StepTraceAnnotation(STEP_ANNOTATION,
+                                                          step_num=i):
+                        metrics = self.train_step(batch)
+                else:
+                    metrics = self.train_step(batch)
+                n = self._batch_samples(batch)
+                self._meter.update(n)
+                self._last_batch_samples = n
+                if (i + 1) % self.log_every == 0:
+                    # the blocking device sync: float() forces the chain
+                    with self._span("metric_sync"):
+                        vals = {k: float(v) for k, v in metrics.items()}
+                    if self._heartbeat is not None:  # we just synced
+                        self._heartbeat.beat()
+                    # tripwires BEFORE the watchdog: the watchdog RAISES
+                    # on the same non-finite values — the durable event
+                    # record must exist by then
+                    self._check_tripwires(epoch, i + 1, vals)
+                    if self._watchdog is not None:
+                        self._watchdog.check(vals, self.state)
+                    rate = self._meter.rate
+                    if rate == rate:  # skip the warmup NaN
+                        vals["samples_per_s"] = rate
+                        self._derived_metrics(vals, rate)
+                    if self._telemetry_jsonl is not None:
+                        self._telemetry_jsonl.write(
+                            {"time": round(time.time(), 3), "epoch": epoch,
+                             "step": i + 1, "rank": self._telemetry_rank,
+                             **vals})
+                    if dist.is_main_process():
+                        self.logger.log_step(epoch, i + 1, vals)
+                if (self.checkpoint is not None
+                        and self._checkpoint_every > 0
+                        and (i + 1) % self._checkpoint_every == 0):
+                    with self._span("checkpoint"):
+                        self._save_checkpoint()
+        finally:
+            # teardown runs on the exception path too: an open profiler
+            # capture is closed, the JSONL sinks are flushed+closed (a
+            # watchdog abort must never leave a truncated metrics file),
+            # and the span trace is dumped for the post-mortem report
+            self._maybe_profile(epoch, -1)
+            self.logger.close()
+            self._teardown_telemetry()
         out = {k: float(v) for k, v in metrics.items()}
         if self._heartbeat is not None:  # epoch-end device sync
             self._heartbeat.beat()
         return out
+
+    def _spanned_iter(self, raw):
+        """Wrap the host-side loader iterator so each batch fetch is a
+        "data_load" span (only built when tracing is on)."""
+        while True:
+            with self._span("data_load"):
+                try:
+                    batch = next(raw)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _check_tripwires(self, epoch: int, step: int, vals: dict) -> None:
+        """Anomaly tripwires at log cadence: pure host arithmetic on the
+        already-synced floats (no extra device blocking); each finding
+        becomes a durable TelemetryEvent JSONL row before anything can
+        raise."""
+        if self._anomaly is None:
+            return
+        for kind, payload in self._anomaly.check(vals, step=step):
+            ev = self._events.emit(kind, step=step, epoch=epoch, **payload)
+            self.logger.info(f"telemetry tripwire: {ev.describe()}")
+
+    def _derived_metrics(self, vals: dict, rate: float) -> None:
+        """StepAccounting-derived metrics at log cadence: step time from
+        the throughput window, then MFU / tokens-per-s / comm-bytes —
+        plus the device-memory high-water where the backend reports one."""
+        if self.accounting is None or not self._last_batch_samples:
+            return
+        sec = self._last_batch_samples / rate
+        vals["step_time_s"] = round(sec, 6)
+        tps = self.accounting.tokens_per_s(sec)
+        if tps is not None:
+            vals["tokens_per_s"] = tps
+        mfu = self.accounting.mfu(sec)
+        if mfu is not None:
+            vals["mfu"] = mfu
+        vals["comm_bytes_per_step"] = self.accounting.comm_bytes_per_step
+        hw = device_memory_highwater()
+        if hw is not None:
+            vals["device_peak_mem_bytes"] = hw
 
     # -- evaluation --------------------------------------------------------
 
@@ -905,7 +1110,8 @@ class Trainer:
                 metrics.update({f"val_{k}": v for k, v in
                                 self.evaluate(val_loader).items()})
             if self.checkpoint is not None:
-                self._save_checkpoint(force=True)
+                with self._span("checkpoint"):
+                    self._save_checkpoint(force=True)
             if dist.is_main_process():
                 self.logger.info(
                     f"epoch {epoch} done in {time.perf_counter() - t0:.2f}s "
@@ -913,6 +1119,7 @@ class Trainer:
                 )
         if self.checkpoint is not None:
             self.checkpoint.wait()
+        self._teardown_telemetry()  # pick up the epoch-end checkpoint spans
         return metrics
 
     def restore(self, sample_batch=None, *, step: int | None = None):

@@ -48,6 +48,112 @@ def collective_counts(hlo_text: str) -> dict[str, int]:
     }
 
 
+# Bytes per element of the HLO shape dtypes a collective can carry.
+# Sub-byte types (s4/u4) round up to 1 — they only appear packed in
+# exotic programs and a 2x overestimate beats a KeyError census hole.
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_ARRAY_SHAPE_RE = re.compile(r"([a-z]\d*[a-z0-9]*)\[([\d,]*)\]")
+
+# A collective's defining line: `%name = <shape> <op>(...)` where <shape>
+# is an array (`f32[16,8]{1,0}`), a flat tuple (`(f32[8]{0}, f32[8]{0})`),
+# or — for variadic async starts — a tuple nesting one level of tuples
+# (`((f32[a], f32[b]), (f32[a], f32[b]))`). `-start` counts (the async op
+# carries the transfer); `-done` does not (no `(` follows the op stem).
+# Longest-first alternation so ragged all-to-alls are not double-counted
+# as plain ones.
+_COLL_DEF_RE = re.compile(
+    r"=\s*(\((?:[^()]|\([^()]*\))*\)|\S+)\s+("
+    + "|".join(sorted(COLLECTIVE_OPS, key=len, reverse=True))
+    + r")(-start)?\(")
+
+# -start ops whose staging tuple follows the (operand(s), result(s),
+# context...) convention — only element [1] is the transferred data.
+# all-reduce-start is NOT here: its tuple (when variadic) IS the result
+# set, so every element counts.
+_START_OPERAND_RESULT = ("all-gather", "collective-permute", "all-to-all",
+                         "ragged-all-to-all", "collective-broadcast",
+                         "reduce-scatter")
+
+
+def _split_top_level(tuple_str: str) -> list[str]:
+    """Top-level elements of a (possibly nested) HLO tuple string:
+    "(f32[4,8]{1,0}, (b, c))" → ["f32[4,8]{1,0}", "(b, c)"] — commas
+    inside nested tuples, dim brackets, and layout braces don't split."""
+    parts, depth, cur = [], 0, []
+    for ch in tuple_str[1:-1]:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts]
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _ARRAY_SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            if dtype.startswith("f8"):  # f8e4m3fn and friends
+                size = 1
+            else:
+                continue  # token/opaque pseudo-shapes carry no data
+        else:
+            size = _DTYPE_BYTES[dtype]
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def collective_bytes(hlo_text: str) -> dict[str, int]:
+    """Per-device result bytes moved by each collective op kind, from the
+    operand/result shapes in OPTIMIZED HLO text — the comm-volume half of
+    the census `collective_counts` only counts.
+
+    The number is the op's *result-shape* footprint summed over its
+    occurrences: for all-reduce that equals the reduced tensor, for
+    all-gather the full gathered output, for reduce-scatter the local
+    shard. It is a per-step, per-device accounting quantity (what
+    `StepAccounting` reports as comm-bytes/step), not a link-level
+    traffic model — algorithm factors (ring all-reduce moves ~2x the
+    tensor over the wire) are deliberately not applied. Async pairs
+    count once at the `-start`, per-op tuple semantics: for the
+    (operand(s), result(s), context...) ops (_START_OPERAND_RESULT) only
+    top-level element [1] — which may itself be a variadic tuple — is
+    the transferred data, so neither the in-flight operand copies nor
+    TPU context tokens (trailing `u32[]` scalars on e.g.
+    collective-permute-start) are billed; all-reduce-start's tuple IS
+    its (variadic) result set and counts whole."""
+    out = {op: 0 for op in COLLECTIVE_OPS}
+    for m in _COLL_DEF_RE.finditer(hlo_text):
+        shape_str, op, is_start = m.group(1), m.group(2), m.group(3)
+        if is_start and shape_str.startswith("("):
+            parts = _split_top_level(shape_str)
+            # scalar u32/s32 trailers are async context tokens, not data
+            parts = [p for p in parts
+                     if not re.match(r"[su]32\[\]", p)]
+            if op in _START_OPERAND_RESULT and len(parts) >= 2:
+                parts = [parts[1]]
+            out[op] += sum(_shape_bytes(p) for p in parts)
+        else:
+            out[op] += _shape_bytes(shape_str)
+    return out
+
+
 def int8_counts(hlo_text: str) -> dict[str, int]:
     """Census of the int8 quantized-matmul op mix (ops/quant.py):
     ``s8_values`` — instructions producing an s8 tensor (the per-operand
@@ -80,6 +186,12 @@ def compiled_invariants(compiled) -> dict:
     * ``collectives`` — `collective_counts` of the optimized HLO.
     * ``int8_ops`` — `int8_counts`: the quantized-matmul convert/dot mix
       (all-zero for unquantized configs).
+    * ``comm_bytes`` — `collective_bytes`: per-device result bytes by
+      collective kind. Together with ``flops`` these are the
+      StepAccounting inputs (telemetry/accounting.py), so committing
+      them makes MFU / comm-volume math a CI tripwire: a partitioning
+      change that moves communication volume — or an accounting bug
+      that would misreport MFU — fails against the pinned numbers.
     """
     mem = compiled.memory_analysis()
     cost = compiled.cost_analysis() or {}
@@ -93,4 +205,5 @@ def compiled_invariants(compiled) -> dict:
         "alias_bytes": int(mem.alias_size_in_bytes),
         "collectives": collective_counts(text),
         "int8_ops": int8_counts(text),
+        "comm_bytes": collective_bytes(text),
     }

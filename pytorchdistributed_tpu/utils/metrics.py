@@ -10,7 +10,9 @@ with its harness shape.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import itertools
 import time
 from typing import Callable
 
@@ -63,7 +65,10 @@ class ThroughputMeter:
     def __init__(self, window: int = 50, warmup: int = 1):
         self.window = window
         self.warmup = warmup
-        self._stamps: list[tuple[float, int]] = []
+        # bounded deque: eviction is O(1) where the old list.pop(0) was
+        # O(window) per step, every step, for the life of the job
+        self._stamps: collections.deque[tuple[float, int]] = \
+            collections.deque(maxlen=window)
         self._seen = 0
 
     def update(self, n_samples: int) -> None:
@@ -71,15 +76,15 @@ class ThroughputMeter:
         if self._seen <= self.warmup:
             return
         self._stamps.append((time.perf_counter(), n_samples))
-        if len(self._stamps) > self.window:
-            self._stamps.pop(0)
 
     @property
     def rate(self) -> float:
+        """samples/s over the window; NaN until two post-warmup stamps
+        exist or when the window spans zero wall time."""
         if len(self._stamps) < 2:
             return float("nan")
         dt = self._stamps[-1][0] - self._stamps[0][0]
-        n = sum(s for _, s in self._stamps[1:])
+        n = sum(s for _, s in itertools.islice(self._stamps, 1, None))
         return n / dt if dt > 0 else float("nan")
 
 

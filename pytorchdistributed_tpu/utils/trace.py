@@ -70,11 +70,49 @@ def family(op_name: str) -> str:
     return re.sub(r"[.\d]+$", "", op_name)
 
 
-def summarize(profile_dir: str, *, steps: int = 1,
+# the Trainer wraps each profiled dispatch in
+# jax.profiler.StepTraceAnnotation(STEP_ANNOTATION, step_num=i) so the
+# capture carries its own step count — the old --steps default of 1
+# silently mislabeled every per-step number 6x (the Trainer captures 6)
+STEP_ANNOTATION = "train"
+
+
+def detect_step_count(events: list[dict]) -> int | None:
+    """Step count from step annotations in the capture: complete events
+    named exactly ``STEP_ANNOTATION`` (the Trainer's host-side
+    StepTraceAnnotation), or events on a profiler-derived "Steps" thread.
+    Max per-thread count so multi-device captures (one step line per
+    device) don't multiply. None when the capture carries no markers."""
+    steps_tids = {(e["pid"], e.get("tid")) for e in events
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"
+                  and "Steps" in e.get("args", {}).get("name", "")}
+    counts: collections.Counter = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        if e.get("name") == STEP_ANNOTATION or key in steps_tids:
+            counts[key] += 1
+    return max(counts.values()) if counts else None
+
+
+def summarize(profile_dir: str, *, steps: int | None = None,
               top: int = 15) -> str:
-    """Human-readable per-family and top-ops tables (``steps`` divides the
-    totals so numbers read as ms/step)."""
-    ops = device_op_durations(load_trace_events(profile_dir))
+    """Human-readable per-family and top-ops tables. ``steps`` divides
+    the totals so numbers read as ms/step; None auto-detects it from the
+    capture's step annotations (falling back to 1 with a warning when
+    the capture predates them)."""
+    events = load_trace_events(profile_dir)
+    note = ""
+    if steps is None:
+        detected = detect_step_count(events)
+        if detected:
+            steps, note = detected, " auto-detected"
+        else:
+            steps, note = 1, (" NO step annotations found — per-step "
+                              "numbers are whole-capture totals; pass "
+                              "--steps")
+    ops = device_op_durations(events)
     fams: collections.Counter = collections.Counter()
     counts: collections.Counter = collections.Counter()
     for name, (dur, n) in ops.items():
@@ -82,7 +120,7 @@ def summarize(profile_dir: str, *, steps: int = 1,
         counts[family(name)] += n
     total = sum(fams.values())
     lines = [f"device op time: {total / steps / 1e3:.1f} ms/step "
-             f"(x{steps} steps; nested regions double-count)"]
+             f"(x{steps} steps{note}; nested regions double-count)"]
     lines.append(f"{'share':>6}  {'ms/step':>9}  {'calls':>6}  op family")
     for fam, dur in fams.most_common(top):
         lines.append(f"{dur / total * 100:5.1f}%  {dur / steps / 1e3:9.2f}"
@@ -99,9 +137,11 @@ def main(argv=None) -> int:
         "pytorchdistributed_tpu.utils.trace",
         description="summarize a jax.profiler capture's device time")
     p.add_argument("profile_dir")
-    p.add_argument("--steps", type=int, default=1,
-                   help="steps inside the capture window (Trainer's "
-                        "profile_dir captures 6)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="steps inside the capture window; default: "
+                        "auto-detected from the capture's step "
+                        "annotations (the Trainer annotates each "
+                        "profiled dispatch)")
     p.add_argument("--top", type=int, default=15)
     args = p.parse_args(argv)
     print(summarize(args.profile_dir, steps=args.steps, top=args.top))

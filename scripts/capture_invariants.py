@@ -4,9 +4,14 @@
     python scripts/capture_invariants.py gpt2s_2l    # a subset
 
 Prints a ready-to-paste COMMITTED dict for
-tests/test_compiled_invariants.py. Run on the same frozen image the
-suite runs on (the numbers are XLA-version-dependent by design — the
-image pins the version). Record any deliberate change in BASELINE.md.
+tests/test_compiled_invariants.py. The field list is derived from
+`utils.hlo.compiled_invariants` itself, so every census it grows —
+including the per-config model-flops ("flops") and per-device
+collective-bytes ("comm_bytes") pair that feeds telemetry
+StepAccounting's MFU/comm math — is stamped into the paste block
+automatically. Run on the same frozen image the suite runs on (the
+numbers are XLA-version-dependent by design — the image pins the
+version). Record any deliberate change in BASELINE.md.
 """
 
 import os

@@ -252,6 +252,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 282372, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "fsdp8": {
         "flops": 267927088.0,
@@ -263,6 +264,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 5, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 8478980, 'all-gather': 3805696, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 327680, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "tp4_dp2": {
         "flops": 134253744.0,
@@ -274,6 +276,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 4, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 1454532, 'all-gather': 1966080, 'reduce-scatter': 0, 'collective-permute': 24576, 'all-to-all': 524288, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # the quantized structural signatures: same programs as dp8/tp4_dp2
     # with the weight matmuls int8. Under dp the collective census must
@@ -295,6 +298,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 10, "int_dots": 5},
+        "comm_bytes": {'all-reduce': 282372, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "tp4_dp2_int8fwd": {
         "flops": 136199872.0,
@@ -306,6 +310,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 4, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 25, "int_dots": 5},
+        "comm_bytes": {'all-reduce': 1463236, 'all-gather': 1658880, 'reduce-scatter': 0, 'collective-permute': 24576, 'all-to-all': 524288, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # r5 entry KEPT (not capturable on this image — partial-auto
     # shard_map; the test skips with that reason rather than failing)
@@ -329,6 +334,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 720392, 'all-gather': 196608, 'reduce-scatter': 0, 'collective-permute': 409600, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "ulysses_seq2": {
         "flops": 119991728.0,
@@ -340,6 +346,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 8, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 720392, 'all-gather': 196608, 'reduce-scatter': 0, 'collective-permute': 16384, 'all-to-all': 524288, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # NOTE the zero all-to-all: at these shapes XLA partitions the
     # one-hot dispatch einsums into all-gather + all-reduce rather than a
@@ -355,6 +362,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 1293072, 'all-gather': 40960, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "gpt2s_2l": {
         "flops": 348754477056.0,
@@ -366,6 +374,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 368633860, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "gpt2m_2l": {
         "flops": 503503126528.0,
@@ -377,6 +386,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 516677636, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # Census caveat, verified with a minimal probe on the r5 image:
     # XLA:CPU lowers the canonical grad reduce-scatter pattern as
@@ -394,6 +404,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 2, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 310824964, 'all-gather': 411557888, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 8388608, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # r5 entry KEPT (not capturable on this image — see pp4_1f1b)
     "gpt2s_4l_pp4": {
@@ -419,6 +430,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 1010868228, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     # the quantized flagship (ISSUE 1 acceptance): 18 converts / 9 int
     # dots = 2 unrolled layers x 4 weight-matmul sites + the tied LM
@@ -434,6 +446,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 18, "int_dots": 9},
+        "comm_bytes": {'all-reduce': 368633860, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
     "resnet50_b32": {
         "flops": 98719342592.0,
@@ -445,6 +458,7 @@ COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 102653096, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
 }
 
@@ -473,6 +487,13 @@ def _assert_invariants(name, inv, want):
             f"committed {want['int8_ops']} — a quantized site silently "
             f"falling back to bf16 (or an int8 op leaking into a bf16 "
             f"config) shows up exactly here")
+    if "comm_bytes" in want:
+        assert inv["comm_bytes"] == want["comm_bytes"], (
+            f"{name}: per-device collective bytes changed: got "
+            f"{inv['comm_bytes']}, committed {want['comm_bytes']} — the "
+            f"comm-volume half of the census, and a StepAccounting input: "
+            f"either communication volume really moved (deliberate?) or "
+            f"the telemetry comm-bytes/MFU math would now misreport")
     lo = want["temp_bytes"] * (1 - TEMP_BYTES_RTOL)
     hi = want["temp_bytes"] * (1 + TEMP_BYTES_RTOL)
     assert lo <= inv["temp_bytes"] <= hi, (
@@ -509,6 +530,7 @@ DECODE_COMMITTED: dict = {
                     "collective-permute": 0, "all-to-all": 0,
                     "ragged-all-to-all": 0, "collective-broadcast": 0},
     "int8_ops": {"s8_values": 0, "int_dots": 0},
+    "comm_bytes": {'all-reduce': 0, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
 }
 
 

@@ -56,10 +56,37 @@ def test_throughput_meter():
     assert m.rate > 0
 
 
+def test_throughput_meter_edge_cases():
+    """The deque-window meter's corners: empty window, warmup-only,
+    single stamp, and a zero-dt window all answer NaN instead of raising
+    or dividing by zero; the window really is bounded (O(1) eviction
+    replaced the O(n) list.pop(0))."""
+    import collections
+
+    m = ThroughputMeter(window=4, warmup=1)
+    assert np.isnan(m.rate)            # empty
+    m.update(10)                       # swallowed by warmup
+    assert np.isnan(m.rate)
+    m.update(10)                       # one stamp: no interval yet
+    assert np.isnan(m.rate)
+    for _ in range(20):
+        m.update(10)
+    assert isinstance(m._stamps, collections.deque)
+    assert len(m._stamps) == 4         # maxlen eviction, not unbounded
+    # zero wall-clock window (identical timestamps) -> NaN, not ZeroDiv
+    z = ThroughputMeter(window=4, warmup=0)
+    z._stamps.append((1.0, 10))
+    z._stamps.append((1.0, 10))
+    assert np.isnan(z.rate)
+
+
 def test_scaling_efficiency():
     assert scaling_efficiency(800.0, 100.0, 8) == pytest.approx(1.0)
     assert scaling_efficiency(720.0, 100.0, 8) == pytest.approx(0.9)
     assert np.isnan(scaling_efficiency(1.0, 0.0, 8))
+    assert np.isnan(scaling_efficiency(800.0, 100.0, 0))   # no chips
+    assert np.isnan(scaling_efficiency(800.0, 100.0, -1))
+    assert np.isnan(scaling_efficiency(800.0, -5.0, 8))    # bad baseline
 
 
 def test_metric_logger_jsonl_sink(tmp_path):
@@ -78,6 +105,34 @@ def test_metric_logger_jsonl_sink(tmp_path):
     assert rows[0]["step"] == 10 and rows[0]["loss"] == 1.5
     assert rows[0]["accuracy"] == 0.25 and "time" in rows[0]
     assert rows[1]["epoch"] == 0 and rows[1]["step"] == 20
+
+
+def test_metric_logger_close_reopen_and_context(tmp_path):
+    """close() is idempotent and composes with multi-epoch use: the sink
+    lazily reopens in append mode on the next log_step, so per-epoch
+    teardown close never truncates earlier rows; the context-manager form
+    closes on exceptions too."""
+    import json
+
+    from pytorchdistributed_tpu.training.logging import MetricLogger
+
+    path = tmp_path / "metrics.jsonl"
+    lg = MetricLogger(name="jsonl-close-test", jsonl_path=str(path))
+    lg.log_step(0, 1, {"loss": 2.0})
+    lg.close()
+    lg.close()  # idempotent
+    lg.log_step(1, 1, {"loss": 1.0})  # reopens, appends
+    lg.close()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["epoch"] for r in rows] == [0, 1]
+    with pytest.raises(RuntimeError):
+        with MetricLogger(name="jsonl-ctx-test",
+                          jsonl_path=str(path)) as ctx_lg:
+            ctx_lg.log_step(2, 1, {"loss": 0.5})
+            raise RuntimeError("boom")
+    assert ctx_lg._jsonl._f is None  # closed despite the exception
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows[-1]["epoch"] == 2  # the pre-exception row is durable
 
 
 def test_trace_summary(tmp_path):
@@ -114,6 +169,56 @@ def test_trace_summary(tmp_path):
     assert "hostwork" not in out
     # 4000us fusion over 2 steps -> 2.00 ms/step
     assert "2.00" in out and "3.0 ms/step" in out
+
+
+def test_trace_auto_detects_step_count(tmp_path):
+    """--steps defaults to auto-detection from the capture's step
+    annotations (the Trainer wraps each profiled dispatch in
+    StepTraceAnnotation("train")): a 2-step capture divides by 2 without
+    any flag, and a capture with no markers falls back to 1 with a
+    warning instead of silently mislabeling."""
+    import gzip
+    import json
+
+    from pytorchdistributed_tpu.utils.trace import (
+        detect_step_count,
+        summarize,
+    )
+
+    run = tmp_path / "plugins" / "profile" / "2026_01_02"
+    run.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 3, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 9, "tid": 5,
+         "args": {"name": "python"}},
+        # two host-side step annotations = a 2-step capture
+        {"ph": "X", "pid": 9, "tid": 5, "name": "train", "dur": 5000},
+        {"ph": "X", "pid": 9, "tid": 5, "name": "train", "dur": 5000},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1", "dur": 4000},
+    ]
+    assert detect_step_count(events) == 2
+    with gzip.open(run / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    out = summarize(str(tmp_path))  # no steps arg
+    assert "x2 steps auto-detected" in out
+    assert "2.00" in out  # 4000us fusion / 2 steps
+    # --steps override still wins
+    out = summarize(str(tmp_path), steps=4)
+    assert "x4 steps" in out and "1.00" in out
+    # no annotations anywhere -> fallback 1 + warning
+    assert detect_step_count(
+        [{"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1",
+          "dur": 10}]) is None
+    for e in events:
+        if e.get("name") == "train":
+            e["name"] = "other"
+    with gzip.open(run / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    out = summarize(str(tmp_path))
+    assert "NO step annotations" in out
 
 
 def test_bf16_policy_preserves_batch_stats():
