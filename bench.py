@@ -7,6 +7,8 @@ the available chip(s) — bf16 compute on the MXU, Pallas flash attention,
 adamw, the jitted Trainer hot loop. Other modes (--bench): "gpt2medium"
 (BASELINE config[3]'s model), "llama1b" (RoPE/SwiGLU/GQA + fused CE),
 "resnet50" (BASELINE config[1] img/s), "generate" (KV-cache decode),
+"serve" (continuous-batching engine under a Poisson arrival trace —
+TTFT + steady-state decode tokens/s; `--mode serve` works too),
 "mlp" (the original smoke), "sweep" (the reference's pipeline split-size
 sweep shape, 03_model_parallel.ipynb:586-623).
 
@@ -524,6 +526,78 @@ def bench_generate() -> dict:
             "batch32_tokens_per_s": round(r32, 1)}
 
 
+def bench_serve() -> dict:
+    """Continuous-batching serving (serving/ServingEngine) under a
+    synthetic Poisson arrival trace: seeded exponential inter-arrivals at
+    PTD_SERVE_RATE req/s feed the slot scheduler in wall-clock time, so
+    queue waits are real. Stamps the steady-state decode rate
+    (tokens/s over decode-tick wall time, prefills excluded) as the
+    headline plus ``ttft_ms_p50/p99`` (queue wait included) and mean
+    ``slot_occupancy`` — the same numbers the engine's telemetry bridge
+    emits. Warmup compiles every prefill bucket + the tick before the
+    clock starts; the record asserts-by-stamping ``recompiles`` (must be
+    0 — the zero-retrace guarantee under load). Runs on CPU-sim or TPU
+    unchanged; knobs via env: PTD_SERVE_SIZE/SLOTS/REQUESTS/RATE/
+    MAX_NEW, PTD_QUANT rides the model config like the training benches."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import SamplingParams, ServingEngine
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    size = os.environ.get("PTD_SERVE_SIZE", "small")
+    num_slots = int(os.environ.get("PTD_SERVE_SLOTS", "8"))
+    n_requests = int(os.environ.get("PTD_SERVE_REQUESTS", "32"))
+    rate = float(os.environ.get("PTD_SERVE_RATE", "8.0"))
+    max_new = int(os.environ.get("PTD_SERVE_MAX_NEW", "32"))
+    cfg = gpt2_config(size, scan_layers=False, quant=_quant_override())
+    params = jax.jit(GPT2(cfg).init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    engine = ServingEngine(GPT2(cfg), params, num_slots=num_slots,
+                           prefill_bucket=128)
+
+    rng = np.random.default_rng(0)
+    lens = rng.integers(16, 97, n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    engine.warmup(prompt_lens=(128,))
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, prompts))
+    while pending or engine.queue_depth or engine.active_count:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, p = pending.pop(0)
+            engine.submit(p, max_new_tokens=max_new,
+                          sampling=SamplingParams(temperature=0.8, top_k=40,
+                                                  seed=engine.queue_depth))
+        if engine.queue_depth or engine.active_count:
+            engine.step()
+        elif pending:
+            time.sleep(min(0.01, max(0.0, pending[0][0] - now)))
+    s = engine.summary()
+    recompiles = sum(dict(serving_engine.TRACE_COUNTS).values()) \
+        - sum(traces0.values())
+    result = {"metric": "serve_decode_tokens_per_s",
+              "value": s["decode_tokens_per_s"], "unit": "tokens/s",
+              "ttft_ms_p50": s["ttft_ms_p50"],
+              "ttft_ms_p99": s["ttft_ms_p99"],
+              "slot_occupancy": s["slot_occupancy"],
+              "requests": n_requests, "num_slots": num_slots,
+              "arrival_rate_per_s": rate,
+              "prefill_ms_mean": s["prefill_ms_mean"],
+              "recompiles": recompiles}
+    _stamp_overrides(result, ("PTD_SERVE_SIZE", "PTD_SERVE_SLOTS",
+                              "PTD_SERVE_REQUESTS", "PTD_SERVE_RATE",
+                              "PTD_SERVE_MAX_NEW", "PTD_QUANT"))
+    return result
+
+
 def bench_mlp() -> dict:
     import optax
 
@@ -884,6 +958,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
                metric="llama1b_s4096_train_tokens_per_s"),
            "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
+           "serve": bench_serve,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 
@@ -929,7 +1004,10 @@ def _probe_device(timeout_s: float = 120.0) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--bench", choices=sorted(BENCHES), default="gpt2")
+    # --mode is an alias for --bench (the serving-engine docs say
+    # `bench.py --mode serve`)
+    parser.add_argument("--bench", "--mode", choices=sorted(BENCHES),
+                        default="gpt2")
     parser.add_argument("--scaling-sim-worker", type=int, default=None,
                         help=argparse.SUPPRESS)  # bench_scaling_sim child
     parser.add_argument("--scaling-sim-mode", type=str, default="dp",

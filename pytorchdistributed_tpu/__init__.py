@@ -10,8 +10,9 @@ with bf16 and activation checkpointing, sequence/context parallelism (ring
 attention, Ulysses) for long context, Switch-MoE expert parallelism over the
 expert axis, memory-budgeted auto placement (the device_map="auto" analog),
 a model zoo (GPT-2, Llama with RoPE/SwiGLU/GQA, BERT, ViT, ResNet) on one
-shared Transformer core, and KV-cache autoregressive generation
-(inference.generate).
+shared Transformer core, KV-cache autoregressive generation
+(inference.generate), and a continuous-batching serving engine over a
+slot-based KV cache (serving.ServingEngine).
 
 Design stance (SURVEY.md §7): the reference's wrapper classes
 (DataParallel/DDP, reference ddp_gpus.py:35) become *sharding-spec choices over
@@ -57,4 +58,7 @@ from pytorchdistributed_tpu.runtime.dist import (  # noqa: F401
     get_world_size,
     is_initialized,
 )
-from pytorchdistributed_tpu.inference import generate  # noqa: F401
+from pytorchdistributed_tpu.inference import (  # noqa: F401
+    generate,
+    generate_bucketed,
+)

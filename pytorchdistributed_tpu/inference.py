@@ -12,25 +12,75 @@ Design notes (XLA semantics):
     prefill forward fills the cache over the whole prompt, then a
     `lax.scan` emits one token per tick; no per-token dispatch from Python;
   * static shapes: the cache is allocated at `max_seq_len` up front and the
-    scan always runs `max_new_tokens` ticks; `eos_id` freezes finished rows
-    (they keep emitting `eos_id`) instead of exiting early;
+    scan always runs `max_new_tokens` ticks; stop ids freeze finished rows
+    (they keep emitting the pad/stop id) instead of exiting early;
   * sharding: params may be sharded (dp/tp rules) — the decode einsums
     partition the same way the training ones do; generate runs under
-    whatever mesh the params live on.
+    whatever mesh the params live on;
+  * retrace control: every distinct (prompt_len, max_new_tokens) pair is a
+    distinct compiled program; `generate_bucketed` pads both up to
+    128-lane buckets so variable-length traffic hits a handful of programs
+    (TRACE_COUNTS is the regression counter the tests pin).
+
+The sampling helpers (`_sample` for batch-uniform params,
+`sample_slots` for the per-row vectorized variant) and the
+`attend_window` cache-window rule are shared with the continuous-batching
+serving engine (serving/).
 """
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+
+# Traced-body invocation counter, keyed by program name: the python body
+# of a jitted function runs only when jax actually (re)traces it, so this
+# is the retrace tripwire the bucketing tests pin (a cache hit never
+# touches it).
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def attend_window(max_seq_len: int, total: int, lanes: int = 128) -> int:
+    """The decode-time attention window for a generation reaching ``total``
+    tokens: 128-lane-rounded, clamped to the model's context. Shared by
+    generate() and the serving engine so both bound per-tick score work
+    the same way."""
+    return min(max_seq_len, -(-total // lanes) * lanes)
+
+
+def stop_ids_tuple(eos_id) -> tuple[int, ...]:
+    """Normalize the ``eos_id`` argument (None | int | sequence of ints) to
+    the static tuple the jitted programs hash on. Tokenizers commonly have
+    several stop ids (e.g. <|eot_id|> and <|end_of_text|>); any of them
+    freezes a row, and frozen rows keep emitting the FIRST id as pad."""
+    if eos_id is None:
+        return ()
+    if isinstance(eos_id, (int, np.integer)):
+        return (int(eos_id),)
+    return tuple(int(e) for e in eos_id)
+
+
+def matches_stop(tok, stop_ids: tuple[int, ...]):
+    """[b] bool: does each token match any of the (static) stop ids?"""
+    if not stop_ids:
+        return jnp.zeros(tok.shape, bool)
+    hit = tok == stop_ids[0]
+    for s in stop_ids[1:]:
+        hit = hit | (tok == s)
+    return hit
 
 
 def _sample(logits, key, *, temperature: float, top_k: int | None,
             top_p: float | None = None, top_p_candidates: int = 256):
-    """One sampling step over [b, vocab] fp32 logits."""
+    """One sampling step over [b, vocab] fp32 logits (batch-uniform
+    params — every row shares temperature/top_k/top_p; the per-row
+    variant is sample_slots)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -60,10 +110,159 @@ def _sample(logits, key, *, temperature: float, top_k: int | None,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_slots(logits, keys, temperature, top_k, top_p, *,
+                 candidates: int = 64):
+    """Per-row sampling over ``[n, vocab]`` fp32 logits where every row
+    carries its OWN (dynamic) sampling params — the serving engine's one
+    compiled sampler for any mix of requests.
+
+      keys:        [n] typed PRNG keys (one stream per request).
+      temperature: [n] f32; <= 0 means greedy for that row.
+      top_k:       [n] i32; <= 0 disables (row keeps all candidates).
+      top_p:       [n] f32; >= 1 disables.
+      candidates:  static candidate-set width C — per-row top_k is a rank
+        mask over the shared lax.top_k(C) prefix (a dynamic per-row k
+        cannot be a static top_k argument), so effective top_k caps at C.
+
+    Greedy rows take idxs[:, 0] == argmax (lax.top_k is index-stable), so
+    a temperature-0 row is bitwise `jnp.argmax` — the parity property the
+    serving tests pin against generate()."""
+    c = min(candidates, logits.shape[-1])
+    vals, idxs = lax.top_k(logits, c)            # [n, c] descending
+    greedy = idxs[:, 0]
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, c), c)
+    vals = jnp.where(jnp.arange(c)[None, :] < k[:, None], vals, -jnp.inf)
+    vals = vals / jnp.maximum(temperature, 1e-6)[:, None]
+    # nucleus: drop candidates once the cumulative probability BEFORE them
+    # reaches p (first candidate always survives) — same rule as _sample
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    vals = jnp.where(cum >= top_p[:, None], -jnp.inf, vals)
+    choice = jax.vmap(jax.random.categorical)(keys, vals)
+    sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def reset_cache_positions(cache, new_index):
+    """Set every position counter in a decode cache collection ("index"
+    per attention layer, "pos_index" in the embedder) to ``new_index`` —
+    the bucketing trick: after a PADDED prefill advanced the counters to
+    the bucket length, rewind them to the true prompt length so decode
+    overwrites the pad rows (which the position mask keeps unattendable
+    until then)."""
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", str(path[-1]))
+        if name in ("index", "pos_index"):
+            return jnp.full_like(leaf, new_index)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _zero_cache(model, prompt):
+    """A fresh all-zero cache collection for ``model`` at ``prompt``'s
+    batch size (shapes via eval_shape — nothing is initialized)."""
+    cache = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), prompt[:, :1])["cache"])
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+
+
+def _decode_ticks(model, weights, cache, first, rng, done, *, length,
+                  temperature, top_k, top_p, top_p_candidates, eos_ids):
+    """The shared decode loop: ``length`` single-token ticks from ``first``
+    under a lax.scan. Returns [b, length] sampled tokens (frozen rows
+    emit the first stop id)."""
+    def tick(carry, _):
+        cache, tok, key, done = carry
+        logits, mut = model.apply(
+            {"params": weights, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, 0].astype(jnp.float32), sub,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      top_p_candidates=top_p_candidates)
+        if eos_ids:
+            nxt = jnp.where(done, eos_ids[0], nxt)
+            done = done | matches_stop(nxt, eos_ids)
+        return (mut["cache"], nxt, key, done), nxt
+
+    (_, _, _, _), toks = lax.scan(
+        tick, (cache, first, rng, done), None, length=length)
+    return toks.T.astype(jnp.int32)
+
+
+def _windowed(model, total: int):
+    """Clone ``model`` with the decode attention window bounded to the
+    slots this generation can actually reach (128-lane-rounded): at long
+    max_seq_len with a short generation the dense-over-whole-cache score
+    work is almost all waste."""
+    cfg = model.cfg
+    attend = attend_window(cfg.max_seq_len, total)
+    if (cfg.decode_attend_len or cfg.max_seq_len) != attend:
+        model = model.clone(
+            cfg=dataclasses.replace(cfg, decode_attend_len=attend))
+    return model
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "top_p_candidates", "eos_id"))
+                     "top_p", "top_p_candidates", "eos_ids"))
+def generate_jit(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    top_p_candidates: int = 256,
+    eos_ids: tuple[int, ...] = (),
+    rng=None,
+):
+    """The jitted body behind generate() (stop ids pre-normalized to a
+    static tuple). Prefer generate(); this is exposed for AOT lowering
+    (tests/test_compiled_invariants.decode_lowered)."""
+    TRACE_COUNTS["generate"] += 1
+    if rng is None:  # same default as generate() (unused when greedy)
+        rng = jax.random.key(0)
+    b, prompt_len = prompt.shape
+    model = _windowed(model, prompt_len + max_new_tokens)
+    cache = _zero_cache(model, prompt)
+    weights = params["params"] if "params" in params else params
+
+    # Chunked prefill: ONE apply over the whole prompt fills every layer's
+    # cache and yields the logits for the first new token — prompt cost is
+    # a single parallel forward, not prompt_len sequential ticks.
+    logits, mut = model.apply(
+        {"params": weights, "cache": cache}, prompt, mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    first = _sample(logits[:, -1].astype(jnp.float32), sub,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    top_p_candidates=top_p_candidates)
+    done = matches_stop(first, eos_ids)
+    toks = _decode_ticks(model, weights, mut["cache"], first, rng, done,
+                         length=max_new_tokens - 1, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
+                         top_p_candidates=top_p_candidates, eos_ids=eos_ids)
+    return jnp.concatenate([prompt, first[:, None], toks], axis=1)
+
+
+def _validate(model, prompt_len: int, max_new_tokens: int) -> None:
+    cfg = model.cfg
+    if not cfg.decode:
+        raise ValueError(
+            "generate() needs a decode-mode model: build it with "
+            "TransformerConfig(decode=True) / *_config(..., decode=True)")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if prompt_len + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {cfg.max_seq_len}")
+
+
 def generate(
     model,
     params,
@@ -74,7 +273,7 @@ def generate(
     top_k: int | None = None,
     top_p: float | None = None,
     top_p_candidates: int = 256,
-    eos_id: int | None = None,
+    eos_id=None,
     rng=None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
@@ -93,71 +292,125 @@ def generate(
       top_p_candidates: how many top logits nucleus sampling considers
         (default 256; set vocab_size for exact nucleus at full-sort cost —
         matters for flat/high-temperature distributions).
-      eos_id: rows that emit it keep emitting it (static-shape early stop).
+      eos_id: a stop id or a sequence of stop ids — rows that emit any of
+        them freeze and keep emitting the first id (static-shape early
+        stop).
       rng: PRNG key for sampling (defaults to key(0); unused when greedy).
 
     Returns int32 ``[batch, prompt_len + max_new_tokens]``: the prompt
     followed by the generated continuation.
     """
-    cfg = model.cfg
-    if not cfg.decode:
-        raise ValueError(
-            "generate() needs a decode-mode model: build it with "
-            "TransformerConfig(decode=True) / *_config(..., decode=True)")
-    b, prompt_len = prompt.shape
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    total = prompt_len + max_new_tokens
-    if total > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
-            f"exceeds max_seq_len {cfg.max_seq_len}")
+    _validate(model, prompt.shape[1], max_new_tokens)
     if rng is None:
         rng = jax.random.key(0)
+    return generate_jit(model, params, prompt,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        top_p_candidates=top_p_candidates,
+                        eos_ids=stop_ids_tuple(eos_id), rng=rng)
 
-    # Bound per-tick attention to the slots this call can actually reach
-    # (128-lane-rounded): at long max_seq_len with a short generation the
-    # dense-over-whole-cache score work is almost all waste. Static under
-    # this jit — prompt_len and max_new_tokens are already trace constants.
-    import dataclasses
 
-    attend = min(cfg.max_seq_len, -(-total // 128) * 128)
-    if (cfg.decode_attend_len or cfg.max_seq_len) != attend:
-        model = model.clone(
-            cfg=dataclasses.replace(cfg, decode_attend_len=attend))
-
-    cache = jax.eval_shape(
-        lambda: model.init(jax.random.key(0), prompt[:, :1])["cache"])
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "top_p", "top_p_candidates", "eos_ids"))
+def _generate_padded(
+    model,
+    params,
+    prompt,          # [b, padded_len] — true prompt in [:, :true_len]
+    true_len,        # dynamic scalar: the unpadded prompt length
+    *,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    top_p_candidates: int,
+    eos_ids: tuple[int, ...],
+    rng,
+):
+    """generate_jit over a right-padded prompt with a DYNAMIC true length:
+    prefill runs at the (static) bucket length, then the cache position
+    counters rewind to ``true_len`` so decode starts there — pad rows sit
+    beyond every row's position mask until the ticks overwrite them.
+    Returns [b, padded_len + max_new_tokens] (continuation starts at
+    column padded_len)."""
+    TRACE_COUNTS["generate_padded"] += 1
+    b, padded_len = prompt.shape
+    model = _windowed(model, padded_len + max_new_tokens)
+    cache = _zero_cache(model, prompt)
     weights = params["params"] if "params" in params else params
 
-    # Chunked prefill: ONE apply over the whole prompt fills every layer's
-    # cache and yields the logits for the first new token — prompt cost is
-    # a single parallel forward, not prompt_len sequential ticks.
     logits, mut = model.apply(
         {"params": weights, "cache": cache}, prompt, mutable=["cache"])
-    cache = mut["cache"]
+    cache = reset_cache_positions(mut["cache"], true_len)
+    last = lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
     rng, sub = jax.random.split(rng)
-    first = _sample(logits[:, -1].astype(jnp.float32), sub,
+    first = _sample(last.astype(jnp.float32), sub,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     top_p_candidates=top_p_candidates)
-    done = (first == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
+    done = matches_stop(first, eos_ids)
+    toks = _decode_ticks(model, weights, cache, first, rng, done,
+                         length=max_new_tokens - 1, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
+                         top_p_candidates=top_p_candidates, eos_ids=eos_ids)
+    return jnp.concatenate([prompt, first[:, None], toks], axis=1)
 
-    def tick(carry, _):
-        cache, tok, key, done = carry
-        logits, mut = model.apply(
-            {"params": weights, "cache": cache}, tok[:, None],
-            mutable=["cache"])
-        key, sub = jax.random.split(key)
-        nxt = _sample(logits[:, 0].astype(jnp.float32), sub,
-                      temperature=temperature, top_k=top_k, top_p=top_p,
-                      top_p_candidates=top_p_candidates)
-        if eos_id is not None:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        return (mut["cache"], nxt, key, done), nxt
 
-    (_, _, _, _), toks = lax.scan(
-        tick, (cache, first, rng, done), None, length=max_new_tokens - 1)
+def _round_up(n: int, q: int) -> int:
+    return -(-n // q) * q
+
+
+def generate_bucketed(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    top_p_candidates: int = 256,
+    eos_id=None,
+    rng=None,
+    bucket: int = 128,
+    pad_id: int = 0,
+):
+    """generate() behind a retrace-bucketing wrapper (thin, non-jit).
+
+    generate()'s compiled program is keyed on the STATIC
+    (prompt_len, max_new_tokens) pair, so variable-length traffic — a
+    chat frontend, an eval harness — retraces per distinct shape. This
+    wrapper pads the prompt up to a ``bucket``-multiple (true length rides
+    along as a dynamic scalar) and rounds max_new_tokens up the same way
+    (extra ticks cost compute, not correctness — the tail is sliced off),
+    so repeated calls hit a handful of compiled programs. Greedy outputs
+    are bitwise-equal to generate()'s: pad positions sit beyond the
+    position mask until decode overwrites them, and masked attention
+    contributes exact zeros. Falls back to exact generate() when the
+    bucketed shapes cannot fit max_seq_len. TRACE_COUNTS["generate_padded"]
+    counts the compiles (the regression test's tripwire)."""
+    b, prompt_len = prompt.shape
+    _validate(model, prompt_len, max_new_tokens)
+    max_seq_len = model.cfg.max_seq_len
+    padded_len = min(_round_up(prompt_len, bucket), max_seq_len)
+    new_bucket = min(_round_up(max_new_tokens, bucket),
+                     max_seq_len - padded_len)
+    if padded_len < prompt_len or new_bucket < max_new_tokens:
+        # bucketing can't fit the context — take the exact-shape program
+        return generate(model, params, prompt,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        top_p_candidates=top_p_candidates, eos_id=eos_id,
+                        rng=rng)
+    if rng is None:
+        rng = jax.random.key(0)
+    padded = jnp.pad(prompt, ((0, 0), (0, padded_len - prompt_len)),
+                     constant_values=pad_id)
+    out = _generate_padded(model, params, padded,
+                           jnp.asarray(prompt_len, jnp.int32),
+                           max_new_tokens=new_bucket,
+                           temperature=temperature, top_k=top_k, top_p=top_p,
+                           top_p_candidates=top_p_candidates,
+                           eos_ids=stop_ids_tuple(eos_id), rng=rng)
     return jnp.concatenate(
-        [prompt, first[:, None], toks.T.astype(jnp.int32)], axis=1)
+        [prompt, out[:, padded_len:padded_len + max_new_tokens]], axis=1)

@@ -112,6 +112,15 @@ class TransformerConfig:
     # None = full max_seq_len. Caller contract: positions >= the window are
     # never live (generate guarantees total <= decode_attend_len).
     decode_attend_len: int | None = None
+    # Slot-based decode (serving/ — the continuous-batching engine): > 0
+    # turns every cache position counter ("index" per attention layer,
+    # "pos_index" in the embedder) into a per-row [decode_slots] vector and
+    # the cache writes into per-row dynamic_update_slices, so each batch
+    # row ("slot") sits at its OWN sequence position — requests of
+    # different lengths decode in one compiled step. Requires decode=True
+    # and batch == decode_slots; 0 keeps the scalar counters generate()
+    # uses (all rows advance together).
+    decode_slots: int = 0
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -157,6 +166,11 @@ class TransformerConfig:
         if self.decode and self.pipeline_stages > 1:
             raise ValueError("decode mode does not compose with pipeline "
                              "parallelism (generate on a dp/tp mesh instead)")
+        if self.decode_slots < 0:
+            raise ValueError(f"decode_slots {self.decode_slots} must be >= 0")
+        if self.decode_slots > 0 and not self.decode:
+            raise ValueError("decode_slots > 0 (slot-based decode) requires "
+                             "decode=True")
         if self.decode_attend_len is not None and (
                 self.decode_attend_len < 1
                 or self.decode_attend_len > self.max_seq_len):
@@ -352,13 +366,25 @@ class SelfAttention(nn.Module):
             v = heads(kv[..., 1, :], cfg.kv_heads)
 
         if cfg.decode:
+            # slot-based decode (serving/): the position counter is a
+            # per-row [decode_slots] vector — each slot advances alone
+            if cfg.decode_slots and b != cfg.decode_slots:
+                raise ValueError(
+                    f"slot-decode batch {b} != decode_slots "
+                    f"{cfg.decode_slots} (the engine owns the batch dim)")
             idx_var = self.variable(
-                "cache", "index", lambda: jnp.zeros((), jnp.int32))
+                "cache", "index",
+                lambda: jnp.zeros((cfg.decode_slots,) if cfg.decode_slots
+                                  else (), jnp.int32))
             idx = idx_var.value
         if cfg.rope:
             cos, sin = rope_tables(cfg.max_seq_len, cfg.head_dim,
                                    cfg.rope_theta)
-            if cfg.decode:
+            if cfg.decode and cfg.decode_slots:
+                # per-row offsets: gather [b, s] positions from the tables
+                pos = idx[:, None] + jnp.arange(s)
+                cos, sin = cos[pos], sin[pos]          # [b, s, d/2]
+            elif cfg.decode:
                 cos = jax.lax.dynamic_slice_in_dim(cos, idx, s)
                 sin = jax.lax.dynamic_slice_in_dim(sin, idx, s)
             else:
@@ -375,10 +401,20 @@ class SelfAttention(nn.Module):
                 "cache", "cached_value", jnp.zeros,
                 (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
             if not self.is_initializing():
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                if cfg.decode_slots:
+                    # per-row writes: each slot lands at its own position
+                    # (vmapped dynamic_update_slice lowers to a scatter)
+                    row = lambda c, u, i: jax.lax.dynamic_update_slice(  # noqa: E731
+                        c, u, (i, 0, 0))
+                    cached_k.value = jax.vmap(row)(
+                        cached_k.value, k.astype(cfg.dtype), idx)
+                    cached_v.value = jax.vmap(row)(
+                        cached_v.value, v.astype(cfg.dtype), idx)
+                else:
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
                 idx_var.value = idx + s
             # Static attention window (decode_attend_len): the cache stays
             # max_seq_len-sized, but scores only cover the slots generation
@@ -393,12 +429,15 @@ class SelfAttention(nn.Module):
             # Masked dense attention over the live window: the current
             # chunk's token i (absolute position idx+i) sees cache slots
             # j <= idx+i. fp32 softmax like the training backends.
-            pos = idx + jnp.arange(s)
-            valid = jnp.arange(attend)[None, :] <= pos[:, None]
+            # (slot decode: idx is [b], so pos/valid grow a leading row
+            # dim — each slot masks against its own position)
+            pos = (idx[:, None] if cfg.decode_slots else idx) + jnp.arange(s)
+            valid = jnp.arange(attend) <= pos[..., None]
             scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-            scores = jnp.where(valid[None, None], scores, -jnp.inf)
+            scores = jnp.where(valid[:, None] if cfg.decode_slots
+                               else valid[None, None], scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum("bhij,bjhd->bihd", probs.astype(cfg.dtype), vc,
                              preferred_element_type=jnp.float32
@@ -540,11 +579,16 @@ def apply_rope(x, cos, sin):
     """Rotate ``x [b, s, h, d]`` by per-position angles (split-halves
     convention: pair dim i with dim i+d/2 — same rotation group as the
     interleaved convention, chosen because it lowers to two slices instead
-    of a strided gather)."""
+    of a strided gather). Tables are ``[s, d/2]`` shared across rows, or
+    ``[b, s, d/2]`` per-row (slot decode: each slot at its own offset)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    c = cos[None, :, None, :].astype(x.dtype)
-    s = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 3:
+        c = cos[:, :, None, :].astype(x.dtype)
+        s = sin[:, :, None, :].astype(x.dtype)
+    else:
+        c = cos[None, :, None, :].astype(x.dtype)
+        s = sin[None, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -791,7 +835,10 @@ class Embedder(nn.Module):
             )
             if cfg.decode:
                 self.pos_index = self.variable(
-                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32))
+                    "cache", "pos_index",
+                    lambda: jnp.zeros(
+                        (cfg.decode_slots,) if cfg.decode_slots else (),
+                        jnp.int32))
 
     def __call__(self, tokens):
         seq_len = tokens.shape[1]
@@ -799,10 +846,14 @@ class Embedder(nn.Module):
         if self.cfg.rope:
             return x
         if self.cfg.decode:
-            p = jax.lax.dynamic_slice_in_dim(
-                self.pos, self.pos_index.value, seq_len)
+            idx = self.pos_index.value
+            if self.cfg.decode_slots:
+                # per-row positions (slot decode): gather [b, s, embed]
+                p = self.pos[idx[:, None] + jnp.arange(seq_len)]
+            else:
+                p = jax.lax.dynamic_slice_in_dim(self.pos, idx, seq_len)
             if not self.is_initializing():
-                self.pos_index.value = self.pos_index.value + seq_len
+                self.pos_index.value = idx + seq_len
             return x + p.astype(self.cfg.dtype)
         return x + self.pos[:seq_len].astype(self.cfg.dtype)
 

@@ -1,0 +1,27 @@
+"""Continuous-batching serving (the first layer above SURVEY.md's L6):
+
+  * engine.py    — ServingEngine: fixed-slot KV cache + one compiled
+                   decode tick + bucketed prefill-into-slot, with a
+                   host-side admission/retirement scheduler and
+                   per-request token streaming
+  * telemetry.py — ServingTelemetry: TTFT / tokens-per-s / queue depth /
+                   slot occupancy as spans + metric JSONL through the
+                   existing telemetry/ package
+
+`bench.py --mode serve` drives it under a Poisson arrival trace;
+examples/serve.py is the train-then-serve demo.
+"""
+
+from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
+    Request,
+    SamplingParams,
+    ServingEngine,
+    decode_tick,
+    prefill_into_slot,
+    slot_models,
+)
+from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
+    SERVE_METRICS_FILE,
+    SERVE_METRICS_GLOB,
+    ServingTelemetry,
+)

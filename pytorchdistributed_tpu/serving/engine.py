@@ -1,0 +1,494 @@
+"""Continuous-batching serving engine: a slot-based KV-cache scheduler
+over a single compiled decode step.
+
+`inference.generate()` is a one-shot batch call: every request in a batch
+must start together and run to the same max_new_tokens, so short requests
+pay for long ones and new arrivals wait for the whole batch to drain.
+This module is the Orca-style fix (iteration-level scheduling) with a
+vLLM-style fixed-slot cache, realized TPU-natively:
+
+  * the engine owns ONE persistent KV cache of ``num_slots`` rows
+    (`[slots, max_seq_len, kv_heads, head_dim]` per layer — the model's
+    existing ``decode=True`` cache collection at ``decode_slots > 0``,
+    where every position counter is a per-row vector);
+  * a jitted **decode tick** (`decode_tick`) advances ALL slots one token
+    per call — per-slot lengths ride the position counters/masks inside
+    the model, per-request sampling params are dynamic `[slots]` arrays
+    (`inference.sample_slots`), and the cache is donated, so steady-state
+    decode is one fixed-shape program with zero retraces and zero cache
+    copies;
+  * a jitted **prefill** (`prefill_into_slot`) runs one request's chunked
+    prompt forward (batch 1, prompts right-padded to a bucket multiple so
+    variable lengths hit a handful of programs) and writes the resulting
+    cache rows into a free slot via `dynamic_update_slice`, rewinding
+    that slot's position counters to the true prompt length;
+  * a host-side scheduler (`ServingEngine`) keeps the request queue,
+    admits a prefill whenever a slot frees, retires on stop-ids /
+    max-token budget, streams tokens per request (callbacks or the
+    `stream()` iterator), and bridges TTFT / tokens-per-s / queue depth /
+    slot occupancy into telemetry/ (serving.telemetry).
+
+Composition: params may be dp/tp sharded (pass the mesh) and quantized
+(`--quant` int8 policies) exactly as generate() accepts them — the tick
+and prefill run the same decode einsums under the same logical rules.
+Greedy outputs are bitwise-equal to generate()'s per request, for any
+admission order (tests/test_serving.py pins it).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import functools
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorchdistributed_tpu.inference import (
+    _zero_cache,
+    sample_slots,
+    stop_ids_tuple,
+)
+from pytorchdistributed_tpu.serving.telemetry import ServingTelemetry
+
+# Traced-body invocation counter (same discipline as inference.
+# TRACE_COUNTS): the zero-recompiles-after-warmup guarantee is asserted
+# against these — a steady-state serving loop must never move them.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def slot_models(model, num_slots: int):
+    """(tick_model, prefill_model) for a causal LM module.
+
+    The tick model decodes with per-row position counters
+    (``decode_slots=num_slots``; batch == slots); the prefill model is the
+    plain scalar-counter decode model at batch 1 (a single request starts
+    from position 0, so it needs no per-row state). Both attend over the
+    full max_seq_len window (slots sit at arbitrary positions) on the
+    cache-masked dense path — the training-time attention backend knob
+    does not apply to decode, so it is pinned to "dense" here to keep the
+    clone warning-free."""
+    cfg = dataclasses.replace(
+        model.cfg, decode=True, attention="dense", decode_attend_len=None,
+        decode_slots=0)
+    return (model.clone(cfg=dataclasses.replace(
+                cfg, decode_slots=num_slots)),
+            model.clone(cfg=cfg))
+
+
+def _leaf_name(path) -> str:
+    return getattr(path[-1], "key", str(path[-1]))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "candidates"),
+    donate_argnames=("cache",))
+def decode_tick(model, weights, cache, tokens, key_data, counts,
+                temperature, top_k, top_p, *, candidates: int):
+    """Advance every slot one token: ONE model apply over ``[slots, 1]``
+    last-tokens (each slot reads/writes its own cache row at its own
+    position) + the per-slot sampler. Free/retired slots tick along as
+    greedy garbage — the fixed-shape price of zero retraces; the host
+    simply ignores their outputs.
+
+    ``key_data``/``counts`` carry each request's seeded stream: token n of
+    a request is sampled with fold_in(key(seed), n), so outputs are
+    deterministic per request no matter which slot or admission order it
+    got (the determinism test's property)."""
+    TRACE_COUNTS["decode_tick"] += 1
+    logits, mut = model.apply({"params": weights, "cache": cache},
+                              tokens[:, None], mutable=["cache"])
+    keys = jax.random.wrap_key_data(key_data)
+    subs = jax.vmap(jax.random.fold_in)(keys, counts)
+    nxt = sample_slots(logits[:, 0].astype(jnp.float32), subs,
+                       temperature, top_k, top_p, candidates=candidates)
+    return mut["cache"], nxt
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "candidates"),
+    donate_argnames=("cache",))
+def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
+                      key_data, temperature, top_k, top_p, *,
+                      candidates: int):
+    """Admit one request: a chunked prompt forward (batch 1, prompt
+    right-padded to the bucket length — ``true_len`` is dynamic) fills a
+    fresh single-row cache, whose rows are written into ``slot`` of the
+    engine cache via dynamic_update_slice; the slot's position counters
+    are rewound to ``true_len`` (pad rows sit beyond the position mask
+    until decode overwrites them — the same trick as
+    inference.generate_bucketed). Returns (cache, first_token): sampling
+    the first token here is what makes TTFT one prefill, not
+    prefill + a decode tick."""
+    TRACE_COUNTS["prefill"] += 1
+    fresh = _zero_cache(model, prompt)
+    logits, mut = model.apply({"params": weights, "cache": fresh}, prompt,
+                              mutable=["cache"])
+    last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)
+    keys = jax.random.wrap_key_data(key_data[None])
+    subs = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((1,), jnp.int32))
+    first = sample_slots(last[:, 0].astype(jnp.float32), subs,
+                         temperature[None], top_k[None], top_p[None],
+                         candidates=candidates)[0]
+
+    def merge(path, big, small):
+        if _leaf_name(path) in ("index", "pos_index"):
+            # rewind to the true prompt length (the padded prefill
+            # advanced the single-row counters to the bucket length)
+            return jnp.where(jnp.arange(big.shape[-1]) == slot,
+                             true_len, big)
+        # K/V rows: [..., slots, max_seq_len, kv_heads, head_dim] — the
+        # slot axis is always 4 dims from the end, scanned-layer or not
+        axis = big.ndim - 4
+        start = tuple(slot if d == axis else 0 for d in range(big.ndim))
+        return jax.lax.dynamic_update_slice(big, small, start)
+
+    new_cache = jax.tree_util.tree_map_with_path(merge, cache, mut["cache"])
+    return new_cache, first
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (dynamic per slot — any mix of requests
+    shares the one compiled tick). temperature 0 = greedy; top_k <= 0 and
+    top_p >= 1 disable their filters; seed starts the request's private
+    PRNG stream."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+
+class Request:
+    """One submitted generation: prompt + budget + sampling + stop ids,
+    and the engine-filled lifecycle (tokens as they stream, timestamps,
+    finish reason). Host-side only — nothing here touches the device."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 sampling: SamplingParams, stop_ids: tuple[int, ...],
+                 on_token=None):
+        self.id = next(Request._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.stop_ids = stop_ids
+        self.on_token = on_token
+        self.new_tokens: list[int] = []
+        self.slot: int | None = None
+        self.done = False
+        self.finish_reason: str | None = None
+        self.submit_time: float | None = None
+        self.first_token_time: float | None = None
+        self.finish_time: float | None = None
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated continuation (int32 [len])."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.new_tokens, np.int32)])
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Time to first token, queue wait included."""
+        if self.first_token_time is None or self.submit_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def decode_tokens_per_s(self) -> float | None:
+        """Post-prefill decode rate of this request (None until done or
+        when the request finished at its first token)."""
+        if self.finish_time is None or self.first_token_time is None:
+            return None
+        dt = self.finish_time - self.first_token_time
+        n = len(self.new_tokens) - 1
+        if n <= 0 or dt <= 0:
+            return None
+        return round(n / dt, 3)
+
+
+class ServingEngine:
+    """The host scheduler over the compiled tick/prefill pair.
+
+    Args:
+      model: a causal LM module (GPT2 / Llama ...) — decode or train
+        config; the engine derives its slot-decode twin either way.
+      params: the trained variables, possibly sharded (pass ``mesh``).
+      num_slots: concurrent requests resident in the KV cache — the
+        engine's batch dim, fixed at compile time.
+      prefill_bucket: prompts are right-padded up to this multiple so
+        variable lengths reuse a handful of prefill programs (clamped to
+        max_seq_len).
+      candidates: static top-k candidate width of the per-slot sampler
+        (per-request top_k caps here; see inference.sample_slots).
+      mesh: optional jax mesh the params live on (tp/dp) — tick/prefill
+        trace under it, exactly like generate().
+      telemetry / telemetry_dir: a ServingTelemetry (or a run dir to
+        build one) for spans + serve-metric JSONL; None = off.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 4,
+                 prefill_bucket: int = 128, candidates: int = 64,
+                 mesh=None, telemetry: ServingTelemetry | None = None,
+                 telemetry_dir=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.candidates = candidates
+        self.mesh = mesh
+        self._tick_model, self._prefill_model = slot_models(model, num_slots)
+        self.cfg = self._tick_model.cfg
+        self.bucket = max(1, min(prefill_bucket, self.cfg.max_seq_len))
+        self._weights = params["params"] if "params" in params else params
+        with self._mesh_ctx():
+            self._cache = _zero_cache(
+                self._tick_model, jnp.zeros((num_slots, 1), jnp.int32))
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        self._key_data = np.zeros((num_slots,) + kd.shape, kd.dtype)
+        self._tokens = np.zeros(num_slots, np.int32)
+        self._counts = np.zeros(num_slots, np.int32)
+        self._temps = np.zeros(num_slots, np.float32)
+        self._top_ks = np.zeros(num_slots, np.int32)
+        self._top_ps = np.ones(num_slots, np.float32)
+        self._free = list(reversed(range(num_slots)))  # pop() -> slot 0
+        self._queue: collections.deque[Request] = collections.deque()
+        self._active: dict[int, Request] = {}
+        if telemetry is None and telemetry_dir is not None:
+            telemetry = ServingTelemetry(telemetry_dir)
+        self.telemetry = telemetry
+        self.reset_stats()
+
+    # ------------------------------------------------------------------
+    # submission
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               sampling: SamplingParams | None = None, stop_ids=None,
+               on_token=None) -> Request:
+        """Queue one request; returns its handle (tokens stream into
+        ``handle.new_tokens`` / the on_token callback as the engine
+        steps). ``stop_ids`` accepts a single id or a sequence."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt_len {prompt.size} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        req = Request(prompt, max_new_tokens, sampling or SamplingParams(),
+                      stop_ids_tuple(stop_ids), on_token)
+        req.submit_time = time.perf_counter()
+        self._queue.append(req)
+        return req
+
+    # ------------------------------------------------------------------
+    # the scheduler loop
+
+    def step(self) -> dict:
+        """One scheduler iteration: admit prefills while slots are free,
+        then ONE decode tick over all slots; deliver + retire from the
+        synced tokens. Returns a small stats dict."""
+        admitted = 0
+        while self._free and self._queue:
+            self._admit(self._queue.popleft())
+            admitted += 1
+        decoded = 0
+        if self._active:
+            t0 = time.perf_counter()
+            with self._span("serve/decode_tick"), self._mesh_ctx():
+                self._cache, nxt = decode_tick(
+                    self._tick_model, self._weights, self._cache,
+                    jnp.asarray(self._tokens),
+                    jnp.asarray(self._key_data),
+                    jnp.asarray(self._counts),
+                    jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks),
+                    jnp.asarray(self._top_ps),
+                    candidates=self.candidates)
+                toks = np.asarray(nxt)  # host sync: streaming delivery
+            dt = time.perf_counter() - t0
+            self._counts += 1
+            st = self._stats
+            st["ticks"] += 1
+            st["tick_s"] += dt
+            st["occupancy_sum"] += len(self._active) / self.num_slots
+            for slot, req in list(self._active.items()):
+                self._deliver(req, int(toks[slot]))
+                decoded += 1
+            st["decode_tokens"] += decoded
+            if self.telemetry is not None:
+                self.telemetry.tick(
+                    tick=st["ticks"], tick_ms=round(dt * 1e3, 3),
+                    active=len(self._active), queued=len(self._queue),
+                    slot_occupancy=round(decoded / self.num_slots, 4))
+        return {"admitted": admitted, "decoded": decoded,
+                "active": len(self._active), "queued": len(self._queue)}
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        """Step until queue and slots drain (tests / batch-mode use)."""
+        while self._queue or self._active:
+            if max_steps <= 0:
+                raise RuntimeError("serving loop did not drain")
+            self.step()
+            max_steps -= 1
+
+    def stream(self, req: Request):
+        """Iterator over one request's tokens, stepping the engine (and
+        every other resident request) as needed — the single-consumer
+        streaming shape; concurrent consumers share the same step()s."""
+        sent = 0
+        while True:
+            while sent < len(req.new_tokens):
+                yield req.new_tokens[sent]
+                sent += 1
+            if req.done:
+                return
+            self.step()
+
+    def warmup(self, prompt_lens=None, max_new_tokens: int = 2) -> None:
+        """Compile the steady state up front: run dummy requests through
+        each prefill bucket plus the decode tick, then reset stats —
+        after this, serving performs ZERO recompiles (TRACE_COUNTS and the
+        jitted programs' _cache_size are the tests' tripwires) and the
+        first real TTFT pays no compile.
+
+        TWO serial rounds per bucket on purpose: the engine's fresh cache
+        is an uncommitted array, so round one compiles each program
+        against it, and jit then recompiles — without retracing — when
+        the cache next arrives committed from another executable's
+        output. Round two runs every program with exactly the
+        steady-state (committed) input shardings."""
+        lens = tuple(prompt_lens) if prompt_lens else (self.bucket,)
+        for n in lens + lens:
+            n = max(1, min(n, self.cfg.max_seq_len - max_new_tokens))
+            self.submit(np.zeros(n, np.int32), max_new_tokens=max_new_tokens)
+            self.run_until_idle()
+        self.reset_stats()
+
+    def close(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.close()
+
+    # ------------------------------------------------------------------
+    # internals
+
+    def _mesh_ctx(self):
+        return (jax.set_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
+
+    def _span(self, name: str):
+        return (self.telemetry.span(name) if self.telemetry is not None
+                else contextlib.nullcontext())
+
+    def _admit(self, req: Request) -> None:
+        slot = self._free.pop()
+        n = req.prompt.size
+        padded_len = min(-(-n // self.bucket) * self.bucket,
+                         self.cfg.max_seq_len)
+        padded = np.zeros((1, padded_len), np.int32)
+        padded[0, :n] = req.prompt
+        kd = np.asarray(jax.random.key_data(
+            jax.random.key(req.sampling.seed)))
+        t0 = time.perf_counter()
+        with self._span("serve/prefill"), self._mesh_ctx():
+            self._cache, first = prefill_into_slot(
+                self._prefill_model, self._weights, self._cache,
+                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                jnp.asarray(kd),
+                jnp.float32(req.sampling.temperature),
+                jnp.int32(req.sampling.top_k),
+                jnp.float32(req.sampling.top_p),
+                candidates=self.candidates)
+            first = int(first)  # sync: the TTFT timestamp is honest
+        now = time.perf_counter()
+        st = self._stats
+        st["prefills"] += 1
+        st["prefill_s"] += now - t0
+        req.slot = slot
+        req.first_token_time = now
+        if req.submit_time is not None:
+            st["ttft_s"].append(now - req.submit_time)
+        self._active[slot] = req
+        self._key_data[slot] = kd
+        self._counts[slot] = 1  # token n samples with fold_in(key, n)
+        self._temps[slot] = req.sampling.temperature
+        self._top_ks[slot] = req.sampling.top_k
+        self._top_ps[slot] = req.sampling.top_p
+        self._deliver(req, first)
+
+    def _deliver(self, req: Request, tok: int) -> None:
+        req.new_tokens.append(tok)
+        self._tokens[req.slot] = tok  # next tick's input for this slot
+        if req.on_token is not None:
+            req.on_token(req, tok)
+        if tok in req.stop_ids:
+            self._retire(req, "stop")
+        elif len(req.new_tokens) >= req.max_new_tokens:
+            self._retire(req, "length")
+
+    def _retire(self, req: Request, reason: str) -> None:
+        req.done = True
+        req.finish_reason = reason
+        req.finish_time = time.perf_counter()
+        del self._active[req.slot]
+        self._free.append(req.slot)
+        self._temps[req.slot] = 0.0  # idle slots tick greedy garbage
+        self._stats["completed"] += 1
+        if self.telemetry is not None:
+            self.telemetry.request(req)
+
+    # ------------------------------------------------------------------
+    # stats
+
+    def reset_stats(self) -> None:
+        self._stats = dict(ticks=0, tick_s=0.0, prefills=0, prefill_s=0.0,
+                           decode_tokens=0, occupancy_sum=0.0, completed=0,
+                           ttft_s=[])
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def summary(self) -> dict:
+        """Aggregate serving metrics since the last reset_stats():
+        steady-state decode tokens/s (decoded tokens over tick wall
+        time, prefills excluded), TTFT percentiles, mean slot
+        occupancy — the fields bench.py --mode serve stamps."""
+        st = self._stats
+        ttfts = np.asarray(st["ttft_s"], np.float64)
+        out = {
+            "requests_completed": st["completed"],
+            "ticks": st["ticks"],
+            "prefills": st["prefills"],
+            "decode_tokens_per_s": (
+                round(st["decode_tokens"] / st["tick_s"], 1)
+                if st["tick_s"] > 0 else None),
+            "slot_occupancy": (
+                round(st["occupancy_sum"] / st["ticks"], 4)
+                if st["ticks"] else None),
+            "prefill_ms_mean": (
+                round(st["prefill_s"] / st["prefills"] * 1e3, 3)
+                if st["prefills"] else None),
+        }
+        if ttfts.size:
+            out["ttft_ms_p50"] = round(
+                float(np.percentile(ttfts, 50)) * 1e3, 3)
+            out["ttft_ms_p99"] = round(
+                float(np.percentile(ttfts, 99)) * 1e3, 3)
+        return out

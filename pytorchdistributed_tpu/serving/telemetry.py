@@ -1,0 +1,80 @@
+"""Serving telemetry bridge — the engine's observability half.
+
+Emits through the existing telemetry/ package rather than growing a
+parallel stack: host spans (``serve/prefill`` / ``serve/decode_tick``)
+go through a SpanTracer and dump to the same ``spans_rank{rank}.trace
+.json`` contract the Trainer uses (so `python -m pytorchdistributed_tpu.
+telemetry merge-trace <dir>` folds serving and training onto one
+timeline), and the serving metrics — per-tick queue depth / slot
+occupancy / tick latency, per-request TTFT and decode tokens-per-s —
+land as JSONL rows in ``serve_metrics_rank{rank}.jsonl`` via the shared
+JsonlWriter (line-buffered append: rows survive a killed server).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from pytorchdistributed_tpu.telemetry.events import (
+    TELEMETRY_DIR_ENV,
+    JsonlWriter,
+)
+from pytorchdistributed_tpu.telemetry.spans import SPAN_TRACE_FILE, SpanTracer
+
+# writer filename / reader glob pair (same contract discipline as
+# events.py's EVENTS_FILE/EVENTS_GLOB — rename together)
+SERVE_METRICS_FILE = "serve_metrics_rank{rank}.jsonl"
+SERVE_METRICS_GLOB = "serve_metrics_rank*.jsonl"
+
+
+class ServingTelemetry:
+    """Span tracer + serving-metric JSONL sink for one engine/rank."""
+
+    def __init__(self, run_dir: str | os.PathLike, rank: int | None = None):
+        self.run_dir = str(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("RANK", "0")))
+        self.tracer = SpanTracer(rank=self.rank)
+        self.metrics = JsonlWriter(os.path.join(
+            self.run_dir, SERVE_METRICS_FILE.format(rank=self.rank)))
+
+    @classmethod
+    def from_env(cls) -> "ServingTelemetry | None":
+        """Construct from the launcher's PTD_TELEMETRY_DIR contract
+        (None when unset) — the same env the Trainer reads."""
+        d = os.environ.get(TELEMETRY_DIR_ENV)
+        return cls(d) if d else None
+
+    def span(self, name: str):
+        return self.tracer.span(name)
+
+    def tick(self, **row) -> None:
+        """One decode-tick metric row (queue depth, occupancy, latency)."""
+        self.metrics.write({"kind": "tick", "time": round(time.time(), 3),
+                            **row})
+
+    def request(self, req) -> None:
+        """One completed-request row: TTFT + per-request decode rate."""
+        ttft = req.ttft_s
+        self.metrics.write({
+            "kind": "request", "time": round(time.time(), 3),
+            "id": req.id, "prompt_len": int(req.prompt.size),
+            "new_tokens": len(req.new_tokens),
+            "finish_reason": req.finish_reason,
+            "ttft_ms": None if ttft is None else round(ttft * 1e3, 3),
+            "decode_tokens_per_s": req.decode_tokens_per_s,
+        })
+
+    def close(self) -> None:
+        self.tracer.dump(os.path.join(
+            self.run_dir, SPAN_TRACE_FILE.format(rank=self.rank)))
+        self.metrics.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -36,12 +36,14 @@ from pytorchdistributed_tpu.utils.hlo import compiled_invariants  # noqa: E402
 from tests.test_compiled_invariants import (  # noqa: E402
     BUILDERS,
     PIPELINE_CONFIGS,
+    SERVING_NAMES,
     decode_lowered,
+    serving_lowered,
 )
 
 
 def main() -> None:
-    names = sys.argv[1:] or list(BUILDERS) + ["decode"]
+    names = sys.argv[1:] or list(BUILDERS) + ["decode"] + list(SERVING_NAMES)
     print("COMMITTED = {")
     for name in names:
         if (name in PIPELINE_CONFIGS
@@ -53,8 +55,10 @@ def main() -> None:
                   f"unsupported by this jax) — previous entry kept",
                   flush=True)
             continue
-        if name == "decode":  # the serving-path pin (DECODE_COMMITTED)
+        if name == "decode":  # the one-shot decode pin (DECODE_COMMITTED)
             inv = compiled_invariants(decode_lowered().compile())
+        elif name in SERVING_NAMES:  # the serving pins (SERVE_COMMITTED)
+            inv = compiled_invariants(serving_lowered(name).compile())
         else:
             trainer, batch = BUILDERS[name]()
             inv = compiled_invariants(trainer.lower_step(batch).compile())

@@ -545,7 +545,7 @@ def decode_lowered():
     import jax
     import jax.numpy as jnp
 
-    from pytorchdistributed_tpu.inference import generate
+    from pytorchdistributed_tpu.inference import generate_jit
     from pytorchdistributed_tpu.models import GPT2, gpt2_config
 
     cfg = gpt2_config("small", num_layers=2, scan_layers=False)
@@ -555,19 +555,159 @@ def decode_lowered():
     params_sds = nn.meta.unbox(boxed)
     dm = GPT2(dataclasses.replace(cfg, decode=True))
     prompt_sds = jax.ShapeDtypeStruct((4, 512), jnp.int32)
-    # the prng key is concrete (tiny); params/prompt stay abstract
-    return generate.lower(dm, params_sds, prompt_sds, max_new_tokens=128,
-                          temperature=0.8, top_k=40, rng=jax.random.key(1))
+    # the prng key is concrete (tiny); params/prompt stay abstract.
+    # generate_jit, not generate: the public name is now a thin wrapper
+    # (stop-id normalization + eager validation) around this jit.
+    return generate_jit.lower(dm, params_sds, prompt_sds,
+                              max_new_tokens=128, temperature=0.8,
+                              top_k=40, rng=jax.random.key(1))
 
 
 def test_decode_invariants():
-    """The serving path's tripwire: the committed decode headline
+    """The one-shot decode path's tripwire: the committed decode headline
     (gpt2s_decode_tokens_per_s, bench.py bench_generate) had no
     hardware-independent guard. Decode is single-chip (the bench's
     committed point), so the collective census should stay all-zero;
     temp bytes bound the KV-cache + scan working set."""
     inv = compiled_invariants(decode_lowered().compile())
     _assert_invariants("decode", inv, DECODE_COMMITTED)
+
+
+# ---------------------------------------------------------------------------
+# serving-engine pins (ISSUE 3): the two compiled programs steady-state
+# serving dispatches — the slot decode tick and the prefill-into-slot —
+# at structural (test) width, 4 slots, the committed candidates=64
+# sampler. Collectives must stay all-zero (single-chip serving; an
+# accidental collective in the tick would tank per-token latency), the
+# int8 census pins the --quant composition (5 weight-matmul sites x 2
+# operand converts forward; prefill adds nothing — same sites), and temp
+# bytes bound the tick's working set next to the [slots, S, kv, hd]
+# donated cache.
+
+SERVING_NAMES = ("serve_tick", "serve_prefill", "serve_tick_int8fwd",
+                 "serve_prefill_int8fwd")
+
+
+def serving_lowered(name: str):
+    """Lower one serving program by pin name (shared with
+    scripts/capture_invariants.py — the recapture ritual covers the
+    SERVING_NAMES)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving.engine import (
+        decode_tick,
+        prefill_into_slot,
+        slot_models,
+    )
+
+    slots, candidates, bucket = 4, 64, 128
+    quant = "int8_fwd" if name.endswith("_int8fwd") else "none"
+    model = GPT2(gpt2_config("test", quant=quant))
+    tick_model, prefill_model = slot_models(model, slots)
+    boxed = jax.eval_shape(model.init, jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    weights_sds = nn.meta.unbox(boxed)["params"]
+    cache_sds = jax.eval_shape(lambda: tick_model.init(
+        jax.random.key(0), jnp.zeros((slots, 1), jnp.int32))["cache"])
+    kd = jax.random.key_data(jax.random.key(0))
+    i32, f32 = jnp.int32, jnp.float32
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    if name.startswith("serve_prefill"):
+        return prefill_into_slot.lower(
+            prefill_model, weights_sds, cache_sds,
+            sds((1, bucket), i32),                       # bucketed prompt
+            sds((), i32), sds((), i32),                  # true_len, slot
+            sds(kd.shape, kd.dtype),
+            sds((), f32), sds((), i32), sds((), f32),    # sampling params
+            candidates=candidates)
+    return decode_tick.lower(
+        tick_model, weights_sds, cache_sds, sds((slots,), i32),
+        sds((slots,) + kd.shape, kd.dtype), sds((slots,), i32),
+        sds((slots,), f32), sds((slots,), i32), sds((slots,), f32),
+        candidates=candidates)
+
+
+# Captured 2026-08-04 on this image (scripts/capture_invariants.py with
+# the serving names). What the numbers say: alias_bytes 262192 on every
+# entry IS the donated slot cache ([4, 128, 4, 16] K+V bf16 x 2 layers +
+# the position counters) — if donation breaks, steady-state serving
+# holds two cache copies and this drops to 0; the int8 rows carry the
+# same 10-convert / 5-int-dot mix as dp8_int8fwd (identical weight-
+# matmul sites, the sampler adds none).
+SERVE_COMMITTED: dict[str, dict] = {
+    "serve_tick": {
+        "flops": 1483049.0,
+        "temp_bytes": 946624,
+        "arg_bytes": 728224,
+        "alias_bytes": 262192,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    "serve_prefill": {
+        "flops": 22284180.0,
+        "temp_bytes": 1253864,
+        "arg_bytes": 728652,
+        "alias_bytes": 262192,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    "serve_tick_int8fwd": {
+        "flops": 2034929.0,
+        "temp_bytes": 947456,
+        "arg_bytes": 728224,
+        "alias_bytes": 262192,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 10, "int_dots": 5},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    "serve_prefill_int8fwd": {
+        "flops": 23949908.0,
+        "temp_bytes": 1257192,
+        "arg_bytes": 728652,
+        "alias_bytes": 262192,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 10, "int_dots": 5},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+}
+
+
+@pytest.mark.parametrize("name", SERVING_NAMES)
+def test_serving_invariants(name):
+    inv = compiled_invariants(serving_lowered(name).compile())
+    _assert_invariants(name, inv, SERVE_COMMITTED[name])
 
 
 def test_analytic_flops_formula_pinned():

@@ -13,7 +13,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.inference import (
+    TRACE_COUNTS,
+    generate,
+    generate_bucketed,
+)
 from pytorchdistributed_tpu.models import (
     GPT2,
     Llama,
@@ -147,6 +151,100 @@ def test_eos_in_prompt_is_inert():
     gen, ref_gen = np.asarray(out[0, 6:]), np.asarray(ref[0, 6:])
     stop = np.argmax(ref_gen == eos) if (ref_gen == eos).any() else len(ref_gen)
     np.testing.assert_array_equal(gen[:stop], ref_gen[:stop])
+
+
+def test_stop_id_sequence():
+    """eos_id accepts a SEQUENCE of stop ids (tokenizers commonly have
+    several): any of them freezes a row, frozen rows keep emitting the
+    first id, and a singleton sequence behaves exactly like the scalar."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=32, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(6)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    free = np.asarray(generate(model, params, prompt, max_new_tokens=8,
+                               temperature=0.0))
+    stop_a, stop_b = int(free[0, 5]), int(free[1, 6])  # mid-run tokens
+    out = np.asarray(generate(model, params, prompt, max_new_tokens=8,
+                              temperature=0.0, eos_id=[stop_a, stop_b]))
+    # row 0 froze at its stop and pads with the FIRST id of the set
+    cut0 = int(np.argmax(free[0, 4:] == stop_a))
+    np.testing.assert_array_equal(out[0, 4:4 + cut0 + 1],
+                                  free[0, 4:4 + cut0 + 1])
+    assert (out[0, 4 + cut0:] == stop_a).all()
+    # row 1 froze on the OTHER id of the set
+    cut1 = int(np.argmax(free[1, 4:] == stop_b))
+    assert out[1, 4 + cut1] == stop_b
+    assert (out[1, 5 + cut1:] == stop_a).all()
+    # singleton sequence == scalar (same compiled program key)
+    one = generate(model, params, prompt, max_new_tokens=8,
+                   temperature=0.0, eos_id=stop_a)
+    seq = generate(model, params, prompt, max_new_tokens=8,
+                   temperature=0.0, eos_id=(stop_a,))
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(seq))
+
+
+def test_bucketed_matches_generate_bitwise():
+    """generate_bucketed pads prompt AND rounds max_new_tokens up to the
+    bucket, yet the returned tokens are bitwise-equal to exact-shape
+    generate() — greedy and seeded-sampling alike (pad rows sit beyond
+    the position mask until decode overwrites them; masked attention
+    contributes exact zeros)."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=512)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(7)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    for L, n in [(5, 8), (17, 3), (33, 40)]:
+        p = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, L)), jnp.int32)
+        ref = generate(dm, params, p, max_new_tokens=n)
+        got = generate_bucketed(dm, params, p, max_new_tokens=n, bucket=64)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    p = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)), jnp.int32)
+    kw = dict(max_new_tokens=6, temperature=0.8, top_k=10,
+              rng=jax.random.key(3))
+    ref = generate(dm, params, p, **kw)
+    got = generate_bucketed(dm, params, p, bucket=64, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_bucketed_trace_count_regression():
+    """The retrace tripwire: many distinct (prompt_len, max_new_tokens)
+    pairs inside one bucket pair must compile exactly ONE padded program
+    (generate() would have compiled one per pair), and repeat calls
+    compile nothing. max_seq_len 384 is unique to this test on purpose:
+    jit caches by config, so sharing another test's config would let ITS
+    compiles absorb ours and zero the delta."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=384)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(8)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    before = TRACE_COUNTS["generate_padded"]
+    for L, n in [(3, 2), (11, 7), (29, 13), (64, 64), (40, 1)]:
+        p = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, L)), jnp.int32)
+        out = generate_bucketed(dm, params, p, max_new_tokens=n, bucket=64)
+        assert out.shape == (2, L + n)
+    assert TRACE_COUNTS["generate_padded"] - before == 1
+    # a second bucket pair is a second (and final) program
+    p = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 70)), jnp.int32)
+    generate_bucketed(dm, params, p, max_new_tokens=80, bucket=64)
+    generate_bucketed(dm, params, p[:, :65], max_new_tokens=66, bucket=64)
+    assert TRACE_COUNTS["generate_padded"] - before == 2
+
+
+def test_bucketed_fallback_when_bucket_overflows_context():
+    """When the rounded shapes cannot fit max_seq_len the wrapper falls
+    back to the exact-shape program (correctness over retrace thrift)."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=16, decode=True)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(9)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 10)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :1])
+    ref = generate(model, params, prompt, max_new_tokens=6)
+    got = generate_bucketed(model, params, prompt, max_new_tokens=6,
+                            bucket=128)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
 def test_generate_with_tensor_sharded_params():
