@@ -28,6 +28,26 @@ import pickle
 import numpy as np
 
 from pytorchdistributed_tpu.data.datasets import ArrayDataset
+from pytorchdistributed_tpu.faults import inject as _inject
+from pytorchdistributed_tpu.faults.retry import IO_RETRY, retry
+
+
+def _read(what: str, fn):
+    """File-read thunk hardened per SURVEY.md §5: the fault-injection
+    hook fires first (``slow_io`` / ``io_err`` specs), then the read runs
+    under bounded-backoff retry — a transient filesystem error (evicted
+    page, NFS hiccup, injected OSError) costs delays and telemetry
+    events, not the training incarnation. Permanent errors still raise
+    after the policy's attempts."""
+    inj = _inject.active()
+
+    def attempt():
+        if inj is not None:
+            inj.on_io(what)
+        return fn()
+
+    return retry(attempt, policy=IO_RETRY, describe=what,
+                 events=inj.events if inj is not None else None)
 
 
 class MappedImageDataset(ArrayDataset):
@@ -41,8 +61,10 @@ class MappedImageDataset(ArrayDataset):
     def __init__(self, root: str | pathlib.Path, split: str = "train",
                  mean: float = 0.0, scale: float = 1 / 255.0):
         root = pathlib.Path(root)
-        images = np.load(root / f"{split}_images.npy", mmap_mode="r")
-        labels = np.load(root / f"{split}_labels.npy", mmap_mode="r")
+        images = _read(f"{split}_images.npy", lambda: np.load(
+            root / f"{split}_images.npy", mmap_mode="r"))
+        labels = _read(f"{split}_labels.npy", lambda: np.load(
+            root / f"{split}_labels.npy", mmap_mode="r"))
         self.num_classes = int(labels.max()) + 1
         self._mean, self._scale = mean, scale
         super().__init__({"image": images, "label": labels})
@@ -63,9 +85,13 @@ def _convert_cifar10(batches_dir: pathlib.Path, split: str) -> None:
     names = ([f"data_batch_{i}" for i in range(1, 6)]
              if split == "train" else ["test_batch"])
     images, labels = [], []
+
+    def read_pickle(path):
+        with open(path, "rb") as f:
+            return pickle.load(f, encoding="bytes")
+
     for name in names:
-        with open(batches_dir / name, "rb") as f:
-            d = pickle.load(f, encoding="bytes")
+        d = _read(name, lambda: read_pickle(batches_dir / name))
         images.append(np.asarray(d[b"data"], np.uint8)
                       .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
         labels.append(np.asarray(d[b"labels"], np.int32))
@@ -117,7 +143,8 @@ class MappedTokenDataset(ArrayDataset):
                  split: str = "train"):
         root = pathlib.Path(root)
         path = root / f"{split}_tokens.npy"
-        arr = np.load(path, mmap_mode="r")
+        arr = _read(f"{split}_tokens.npy",
+                    lambda: np.load(path, mmap_mode="r"))
         # Bounds come from the UN-windowed on-disk array: a 1-D stream is
         # truncated to a seq_len multiple below, so seq_len-dependent bounds
         # would let a cached scan from one seq_len skip tokens (e.g. a

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from pytorchdistributed_tpu.data.sampler import ShardedSampler
+from pytorchdistributed_tpu.faults import inject as _inject
 
 
 class DataLoader:
@@ -63,7 +64,14 @@ class DataLoader:
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         indices = self.sampler.local_indices()
         nbatches = len(self)
+        # Fault-injection hook (faults/inject.py, None without a
+        # PTD_FAULTS plan): slow_io makes this rank's batch assembly
+        # straggle, io_err crashes it mid-epoch — the loader-side faults
+        # the chaos suite drives through run.py.
+        inj = _inject.active()
         for b in range(nbatches):
+            if inj is not None:
+                inj.on_io("data_batch")
             batch_idx = indices[b * self.batch_size : (b + 1) * self.batch_size]
             yield self.dataset[batch_idx]
 

@@ -1,0 +1,26 @@
+"""Fault injection + retry/backoff — the harness that proves SURVEY.md
+§5's "failure detection / elastic recovery" actually recovers.
+
+``inject``: a deterministic FaultPlan (``PTD_FAULTS`` env spec /
+``run.py --faults``) fired through hooks in the Trainer step loop, the
+data loaders and the checkpoint save path. ``retry``: bounded
+exponential-backoff retry wrapped around checkpoint and data-file I/O.
+Both emit TelemetryEvents so every injection and every retry is durable
+in the run record.
+"""
+
+from pytorchdistributed_tpu.faults.inject import (  # noqa: F401
+    CRASH_EXIT_CODE,
+    EXIT_PREEMPTED,
+    FAULTS_ENV,
+    FAULTS_STATE_ENV,
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+from pytorchdistributed_tpu.faults.retry import (  # noqa: F401
+    IO_RETRY,
+    RetryPolicy,
+    retry,
+    retryable,
+)

@@ -1,0 +1,327 @@
+"""Deterministic fault injection — the chaos half of SURVEY.md §5.
+
+``run.py`` can detect crashes and hung ranks and resize the group, but
+nothing could *prove* recovery worked: every fault-tolerance test had to
+hand-roll its own marker-file crash worker. This module is the shared
+harness. A ``FaultPlan`` is parsed from the ``PTD_FAULTS`` env spec (or
+``run.py --faults``), e.g.::
+
+    crash@step=7,rank=1; hang@step=12,rank=0; nan@step=9; preempt@step=15;
+    ckpt_corrupt@step=20; slow_io@p=0.3,ms=200; io_err@n=2
+
+and a ``FaultInjector`` fires it through hooks the Trainer step loop, the
+data loader, and the checkpoint save path already call:
+
+  * ``crash@step=S[,rank=R][,code=C]`` — the rank exits ``C`` (default
+    41) just before optimizer step S;
+  * ``hang@step=S[,rank=R]`` — the rank SIGSTOPs itself (alive, silent,
+    never exits — the collective-wedge analog heartbeats must catch);
+  * ``preempt@step=S[,rank=R]`` — the rank SIGTERMs itself: the
+    Trainer's preemption handler finishes step S, forces a durable
+    checkpoint and exits ``EXIT_PREEMPTED``;
+  * ``nan@step=S[,rank=R]`` — step S's loss is poisoned to NaN so the
+    anomaly tripwire records it and the watchdog raises;
+  * ``ckpt_corrupt[@step=S][,rank=R]`` — the first checkpoint committed
+    at/after step S has its largest payload file bit-flipped AFTER its
+    integrity manifest is written (a torn/corrupted save the verify
+    chain must detect and walk past);
+  * ``slow_io[@p=P][,ms=M][,rank=R]`` — I/O hooks sleep M ms with
+    probability P (tail-latency injection);
+  * ``io_err[@p=P][,n=N][,rank=R]`` — I/O hooks raise OSError with
+    probability P, at most N times total (N=0 → uncapped): the transient
+    class ``faults.retry`` must absorb.
+
+Every injection emits a TelemetryEvent before it acts, so the launcher's
+per-incarnation summaries show *why* an incarnation died. Step-targeted
+faults are one-shot: fired markers persist in ``PTD_FAULTS_STATE`` (the
+launcher provisions a directory that survives restarts), so a crash at
+step 7 does not re-fire after the relaunched incarnation resumes and
+trains step 7's successor — without the marker every deterministic crash
+would be an infinite crash loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import sys
+import time
+
+from pytorchdistributed_tpu.telemetry.events import (
+    EVENT_FAULT,
+    EventLog,
+)
+
+FAULTS_ENV = "PTD_FAULTS"
+FAULTS_STATE_ENV = "PTD_FAULTS_STATE"
+
+#: Worker exit code for a graceful preemption (SIGTERM → finish step →
+#: forced durable checkpoint → exit). Distinct from every failure code in
+#: the repo so the launcher can restart it WITHOUT charging the
+#: same-rank failure tracker that drives elastic shrink.
+EXIT_PREEMPTED = 77
+
+#: Default exit code for an injected crash (arbitrary, recognizable).
+CRASH_EXIT_CODE = 41
+
+_STEP_KINDS = ("crash", "hang", "preempt", "nan")
+_IO_KINDS = ("slow_io", "io_err")
+KINDS = frozenset(_STEP_KINDS + _IO_KINDS + ("ckpt_corrupt",))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed ``kind@k=v,...`` entry."""
+
+    kind: str
+    step: int | None = None
+    rank: int | None = None
+    p: float = 1.0
+    ms: float = 100.0
+    n: int = 0
+    code: int = CRASH_EXIT_CODE
+
+    def describe(self) -> str:
+        parts = [self.kind]
+        if self.step is not None:
+            parts.append(f"step={self.step}")
+        if self.rank is not None:
+            parts.append(f"rank={self.rank}")
+        return parts[0] + ("@" + ",".join(parts[1:]) if parts[1:] else "")
+
+
+class FaultPlan:
+    """The parsed spec: an ordered list of FaultSpecs."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = list(specs)
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        specs = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, _, params = entry.partition("@")
+            kind = kind.strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {entry!r} "
+                    f"(known: {', '.join(sorted(KINDS))})")
+            kw: dict = {}
+            for item in params.split(",") if params else []:
+                item = item.strip()
+                if not item:
+                    continue
+                key, _, val = item.partition("=")
+                key, val = key.strip(), val.strip()
+                try:
+                    if key in ("step", "rank", "n", "code"):
+                        kw[key] = int(val)
+                    elif key in ("p", "ms"):
+                        kw[key] = float(val)
+                    else:
+                        raise ValueError(f"unknown param {key!r}")
+                except ValueError as e:
+                    raise ValueError(
+                        f"bad fault param {item!r} in {entry!r}: {e}"
+                    ) from None
+            if kind in _STEP_KINDS and "step" not in kw:
+                raise ValueError(
+                    f"fault {kind!r} needs step= (got {entry!r})")
+            if "p" in kw and not 0.0 <= kw["p"] <= 1.0:
+                raise ValueError(f"p must be in [0, 1], got {kw['p']}")
+            specs.append(FaultSpec(kind=kind, **kw))
+        return cls(specs)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        spec = os.environ.get(FAULTS_ENV, "").strip()
+        return cls.parse(spec) if spec else None
+
+
+class FaultInjector:
+    """Fires a FaultPlan through the subsystem hooks for ONE rank.
+
+    One-shot bookkeeping: step-targeted specs record a marker — a file
+    in ``state_dir`` when set (survives relaunches; the launcher's
+    ``PTD_FAULTS_STATE`` contract), else an in-process set. Probabilistic
+    specs draw from a Random seeded on (spec string order, rank), so a
+    given plan replays identically."""
+
+    def __init__(self, plan: FaultPlan, *, rank: int = 0,
+                 state_dir: str | None = None, events: EventLog | None = None,
+                 seed: int = 0):
+        self.plan = plan
+        self.rank = rank
+        self.state_dir = state_dir
+        self.events = events
+        self._rng = random.Random((seed, rank, len(plan.specs)).__hash__())
+        self._fired: set[str] = set()
+        self._io_err_count = [0] * len(plan.specs)
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector | None":
+        plan = FaultPlan.from_env()
+        if plan is None:
+            return None
+        rank = int(os.environ.get("RANK", "0"))
+        return cls(plan, rank=rank,
+                   state_dir=os.environ.get(FAULTS_STATE_ENV) or None,
+                   events=EventLog.from_env(rank))
+
+    # -- one-shot bookkeeping ---------------------------------------------
+
+    def _once(self, key: str) -> bool:
+        """True exactly once per (key, rank) across incarnations."""
+        key = f"{key}_rank{self.rank}"
+        if key in self._fired:
+            return False
+        self._fired.add(key)
+        if self.state_dir:
+            marker = os.path.join(self.state_dir, key)
+            if os.path.exists(marker):
+                return False
+            try:
+                with open(marker, "x"):
+                    pass
+            except FileExistsError:
+                return False
+        return True
+
+    def _emit(self, spec: FaultSpec, **data) -> None:
+        if self.events is not None:
+            self.events.emit(EVENT_FAULT, step=data.pop("step", -1),
+                             fault=spec.kind, spec=spec.describe(), **data)
+            self.events.flush()
+
+    def _mine(self, spec: FaultSpec) -> bool:
+        return spec.rank is None or spec.rank == self.rank
+
+    # -- hooks -------------------------------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Trainer hook, called just BEFORE optimizer step ``step``
+        (1-based, global across incarnations) runs. crash/hang exit here;
+        preempt SIGTERMs self so the Trainer's handler finishes the step
+        and checkpoints before exiting."""
+        for i, spec in enumerate(self.plan.specs):
+            if (spec.kind not in ("crash", "hang", "preempt")
+                    or not self._mine(spec) or spec.step != step):
+                continue
+            if not self._once(f"{i}_{spec.kind}@{spec.step}"):
+                continue
+            self._emit(spec, step=step)
+            if spec.kind == "crash":
+                sys.stderr.write(
+                    f"[faults] rank {self.rank} injected crash at step "
+                    f"{step} (exit {spec.code})\n")
+                sys.stderr.flush()
+                os._exit(spec.code)
+            elif spec.kind == "hang":
+                sys.stderr.write(
+                    f"[faults] rank {self.rank} injected hang at step "
+                    f"{step} (SIGSTOP)\n")
+                sys.stderr.flush()
+                os.kill(os.getpid(), signal.SIGSTOP)
+            else:  # preempt: the SIGTERM handler takes it from here
+                sys.stderr.write(
+                    f"[faults] rank {self.rank} injected preemption at "
+                    f"step {step} (SIGTERM)\n")
+                sys.stderr.flush()
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def poison_nan(self, step: int) -> bool:
+        """Trainer hook, called AFTER step ``step``: True when this
+        step's loss should be replaced with NaN (the tripwire/watchdog
+        pair must record then raise on it)."""
+        for i, spec in enumerate(self.plan.specs):
+            if (spec.kind == "nan" and self._mine(spec)
+                    and spec.step == step
+                    and self._once(f"{i}_nan@{spec.step}")):
+                self._emit(spec, step=step)
+                return True
+        return False
+
+    def on_io(self, what: str, *, step: int = -1) -> None:
+        """I/O-path hook (data file reads, loader batches, checkpoint
+        save/restore): slow_io sleeps, io_err raises OSError — which the
+        retry-wrapped call sites absorb up to their policy bound."""
+        for i, spec in enumerate(self.plan.specs):
+            if not self._mine(spec):
+                continue
+            if spec.kind == "slow_io":
+                if self._rng.random() < spec.p:
+                    self._emit(spec, step=step, what=what, ms=spec.ms)
+                    time.sleep(spec.ms / 1e3)
+            elif spec.kind == "io_err":
+                if spec.n and self._io_err_count[i] >= spec.n:
+                    continue
+                if self._rng.random() < spec.p:
+                    self._io_err_count[i] += 1
+                    self._emit(spec, step=step, what=what,
+                               count=self._io_err_count[i])
+                    raise OSError(
+                        f"injected io_err ({what}, "
+                        f"failure {self._io_err_count[i]})")
+
+    def on_checkpoint_saved(self, step: int, step_dir) -> bool:
+        """Checkpoint hook, called after a save COMMITS and its manifest
+        is written: a matching ckpt_corrupt spec bit-flips the largest
+        payload file under ``step_dir`` (manifest untouched — verification
+        must catch the mismatch). Returns whether corruption happened."""
+        import pathlib
+
+        step_dir = pathlib.Path(step_dir)
+        for i, spec in enumerate(self.plan.specs):
+            if spec.kind != "ckpt_corrupt" or not self._mine(spec):
+                continue
+            if spec.step is not None and step < spec.step:
+                continue
+            if not self._once(f"{i}_ckpt_corrupt"):
+                continue
+            files = sorted(
+                (p for p in step_dir.rglob("*")
+                 if p.is_file() and "manifest" not in p.name.lower()),
+                key=lambda p: p.stat().st_size, reverse=True)
+            if not files:
+                return False
+            target = files[0]
+            data = bytearray(target.read_bytes())
+            span = min(64, len(data))
+            for j in range(span):
+                data[j] ^= 0xFF
+            target.write_bytes(bytes(data))
+            self._emit(spec, step=step,
+                       file=str(target.relative_to(step_dir)))
+            sys.stderr.write(
+                f"[faults] rank {self.rank} corrupted checkpoint step "
+                f"{step} ({target.name})\n")
+            sys.stderr.flush()
+            return True
+        return False
+
+
+# Process-global injector: every subsystem (Trainer, CheckpointManager,
+# data loaders) shares ONE instance so count-limited specs (io_err@n=2)
+# mean "2 failures in this process", not 2 per component. Cached on first
+# use; tests that mutate PTD_FAULTS call reset_active().
+_ACTIVE: list = [False, None]  # [resolved?, injector]
+
+
+def active() -> FaultInjector | None:
+    if not _ACTIVE[0]:
+        _ACTIVE[0], _ACTIVE[1] = True, FaultInjector.from_env()
+    return _ACTIVE[1]
+
+
+def reset_active() -> None:
+    _ACTIVE[0], _ACTIVE[1] = False, None

@@ -1,0 +1,95 @@
+"""Bounded retry with exponential backoff + jitter — the I/O hardening
+half of the fault subsystem (SURVEY.md §5).
+
+Checkpoint save/restore and data file reads go through ``retry``: a
+transient filesystem error (GCS 5xx surfacing as OSError, an NFS hiccup,
+a page-cache eviction race) costs a delay and a durable TelemetryEvent
+instead of the incarnation — restarting a pod-scale job to re-read one
+file is the most expensive retry policy there is. Permanent errors
+(anything outside ``policy.retry_on``, or ``max_attempts`` exhausted)
+still raise: retry must narrow the failure domain, never hide it.
+
+Jitter is multiplicative and seeded per call site (``rng``): a thundering
+herd of ranks retrying the same shared-filesystem path must decorrelate,
+but the chaos suite needs reproducible schedules — both callers pick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, TypeVar
+
+from pytorchdistributed_tpu.telemetry.events import EVENT_RETRY
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` total tries; delay before try k+1 is
+    ``min(base_delay_s * backoff**(k-1), max_delay_s)`` scaled by a
+    uniform jitter in ``[1, 1 + jitter]``. Only ``retry_on`` exception
+    types are retried — everything else propagates on the first throw."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    backoff: float = 2.0
+    max_delay_s: float = 2.0
+    jitter: float = 0.25
+    retry_on: tuple[type[BaseException], ...] = (OSError,)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        base = min(self.base_delay_s * self.backoff ** (attempt - 1),
+                   self.max_delay_s)
+        return base * (1.0 + self.jitter * rng.random())
+
+
+#: Default policy for checkpoint/data I/O: 4 tries over ~0.35 s worst
+#: case — long enough to ride out a filesystem hiccup, short enough that
+#: a genuinely dead disk fails the rank before the heartbeat timeout
+#: attributes the stall to a hang.
+IO_RETRY = RetryPolicy()
+
+
+def retry(fn: Callable[[], T], *, policy: RetryPolicy = IO_RETRY,
+          describe: str = "", events=None, rng: random.Random | None = None,
+          sleep: Callable[[float], None] = time.sleep) -> T:
+    """Call ``fn`` until it returns, retrying ``policy.retry_on`` failures
+    with backoff. Each retry emits an ``EVENT_RETRY`` TelemetryEvent on
+    ``events`` (an EventLog, or None) so post-mortems can see the I/O
+    flakiness that preceded a failure; the final attempt's exception
+    propagates unchanged."""
+    rng = rng if rng is not None else random.Random()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            delay = policy.delay(attempt, rng)
+            if events is not None:
+                events.emit(EVENT_RETRY, step=-1, op=describe or "io",
+                            attempt=attempt, max_attempts=policy.max_attempts,
+                            delay_ms=round(delay * 1e3, 3),
+                            error=f"{type(e).__name__}: {e}"[:200])
+            sleep(delay)
+
+
+def retryable(policy: RetryPolicy = IO_RETRY, *, describe: str = "",
+              events=None):
+    """Decorator form of ``retry`` for fixed call sites."""
+
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            return retry(lambda: fn(*args, **kwargs), policy=policy,
+                         describe=describe or fn.__name__, events=events)
+
+        inner.__name__ = getattr(fn, "__name__", "retryable")
+        return inner
+
+    return wrap

@@ -49,6 +49,18 @@ anyway, so flapping is bounded by the ``--max-restarts`` budget. There
 is no external "node joined" signal on a single-host agent (torchrun
 regrows on rendezvous arrivals), so a stable-then-interrupted relaunch
 boundary is the honest stand-in.
+
+Preemption + chaos (SURVEY.md §5 completion): a SIGTERM/SIGINT received
+by the agent is FORWARDED to the workers, whose Trainers drain a durable
+checkpoint and exit ``EXIT_PREEMPTED`` within ``--preempt-grace`` seconds
+— Ctrl-C never orphans a group. A worker exiting ``EXIT_PREEMPTED`` on
+its own (the platform preempted one VM, or an injected ``preempt@step``)
+is restarted but never charged to the same-rank tracker above: reclaimed
+capacity is not evidence of a bad slot. ``--faults`` exports a
+deterministic fault-injection spec (``PTD_FAULTS``; see faults/inject.py)
+plus a marker directory (``PTD_FAULTS_STATE``) that keeps step-targeted
+faults one-shot across relaunches — the chaos-suite rig every
+fault-tolerance claim in this repo is tested through.
 """
 
 from __future__ import annotations
@@ -63,6 +75,12 @@ import sys
 import tempfile
 import time
 
+from pytorchdistributed_tpu.faults.inject import (
+    EXIT_PREEMPTED,
+    FAULTS_ENV,
+    FAULTS_STATE_ENV,
+    FaultPlan,
+)
 from pytorchdistributed_tpu.runtime.heartbeat import (
     HEARTBEAT_DIR_ENV,
     stale_ranks,
@@ -82,10 +100,14 @@ def _free_port() -> int:
 def _spawn_group(argv, nproc: int, port: int,
                  devices_per_proc: int | None,
                  heartbeat_dir: str | None = None,
-                 telemetry_dir: str | None = None) -> list[subprocess.Popen]:
+                 telemetry_dir: str | None = None,
+                 extra_env: dict[str, str] | None = None,
+                 ) -> list[subprocess.Popen]:
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
         env.update({
             "RANK": str(rank),
             "LOCAL_RANK": str(rank),
@@ -106,14 +128,20 @@ def _spawn_group(argv, nproc: int, port: int,
     return procs
 
 
-def _kill_group(procs) -> None:
+def _kill_group(procs, *, sig: int = signal.SIGTERM,
+                grace: float = 10.0) -> None:
+    """Signal every live worker and SIGKILL stragglers after ``grace``
+    seconds. The default (SIGTERM, 10 s) is the failure-teardown path; the
+    agent's signal forwarding reuses it with the received signal and
+    ``--preempt-grace`` so Trainers get one window to drain durable
+    checkpoints — one escalation point, not two."""
     for p in procs:
         if p.poll() is None:
-            p.send_signal(signal.SIGTERM)
+            p.send_signal(sig)
             # a SIGSTOPped (hung-and-frozen) worker can't handle SIGTERM;
-            # wake it so termination isn't stuck behind the 10s escalation
+            # wake it so termination isn't stuck behind the escalation
             p.send_signal(signal.SIGCONT)
-    deadline = time.time() + 10
+    deadline = time.time() + max(grace, 0.1)
     for p in procs:
         try:
             p.wait(max(0.1, deadline - time.time()))
@@ -121,7 +149,25 @@ def _kill_group(procs) -> None:
             p.kill()
 
 
+def _forward_signal_and_drain(procs, signum: int, grace: float) -> None:
+    """Agent received SIGTERM/SIGINT: forward it to every live worker —
+    Ctrl-C must not orphan the group, and a platform preemption notice
+    must reach the Trainers (SIGINT is translated to SIGTERM, the signal
+    their preemption handler owns)."""
+    fwd = signal.SIGTERM if signum == signal.SIGINT else signum
+    _kill_group(procs, sig=fwd, grace=grace)
+
+
 def main(argv=None) -> int:
+    owned_dirs: list[str] = []
+    try:
+        return _main(argv, owned_dirs)
+    finally:
+        for d in owned_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _main(argv, owned_dirs: list[str]) -> int:
     parser = argparse.ArgumentParser(
         "pytorchdistributed_tpu.run",
         description="torchrun-equivalent launcher "
@@ -157,6 +203,17 @@ def main(argv=None) -> int:
                              "--nproc-per-node) on later restarts — "
                              "torchrun --nnodes=min:max resize semantics "
                              "(0 = fixed size)")
+    parser.add_argument("--preempt-grace", type=float, default=30.0,
+                        help="seconds workers get to drain a graceful "
+                             "checkpoint after the agent forwards a "
+                             "SIGTERM/SIGINT it received, before the "
+                             "escalating teardown")
+    parser.add_argument("--faults", type=str, default=None,
+                        help="deterministic fault-injection spec exported "
+                             f"to workers as {FAULTS_ENV} (e.g. "
+                             "'crash@step=7,rank=1; nan@step=9; "
+                             "preempt@step=15'); one-shot markers persist "
+                             f"across relaunches via {FAULTS_STATE_ENV}")
     parser.add_argument("--elastic-regrow-after", type=float, default=30.0,
                         help="minimum healthy uptime (s) of the failing "
                              "incarnation before a restart also probes the "
@@ -173,6 +230,29 @@ def main(argv=None) -> int:
     last_failed, consecutive = None, 0
     if args.telemetry_dir is not None:
         os.makedirs(args.telemetry_dir, exist_ok=True)
+    # Fault-injection contract: --faults (or an inherited PTD_FAULTS)
+    # reaches workers through their spawn environment — never by
+    # mutating the agent's own os.environ, which would leak specs into
+    # later in-process main() calls and unrelated subprocesses. The
+    # agent provisions ONE marker directory for the whole run so
+    # step-targeted faults stay one-shot across relaunches (a crash@step
+    # spec that re-fired every incarnation would be an infinite crash
+    # loop, not a test).
+    faults_env: dict[str, str] = {}
+    if args.faults:
+        FaultPlan.parse(args.faults)  # fail fast on a typo'd spec
+        faults_env[FAULTS_ENV] = args.faults
+    if ((args.faults or os.environ.get(FAULTS_ENV))
+            and not os.environ.get(FAULTS_STATE_ENV)):
+        state_dir = tempfile.mkdtemp(prefix="ptd_faults_")
+        faults_env[FAULTS_STATE_ENV] = state_dir
+        owned_dirs.append(state_dir)
+    # Signal forwarding (graceful teardown / preemption notice): the
+    # handler only records the signal — forwarding and the grace wait
+    # happen in the monitor loop, outside async-signal context.
+    signals_seen: list[int] = []
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda s, f: signals_seen.append(s))
     # Per-incarnation telemetry aggregation: byte offsets into the
     # per-rank event files advance as the agent reports, so each summary
     # covers exactly the incarnation that just ended — the tripwire
@@ -198,10 +278,29 @@ def main(argv=None) -> int:
         spawned_at = time.time()
         procs = _spawn_group(worker_argv, nproc, port,
                              args.devices_per_proc, hb_dir,
-                             args.telemetry_dir)
+                             args.telemetry_dir, faults_env)
         failed, why = [], "failed"
         while not failed:
             time.sleep(args.monitor_interval)
+            if signals_seen:
+                # graceful teardown: forward the signal so Trainers drain
+                # durable checkpoints (never orphan workers on Ctrl-C)
+                signum = signals_seen[0]
+                print(f"[run] received {signal.Signals(signum).name}; "
+                      f"forwarding to workers "
+                      f"(grace {args.preempt_grace}s)", file=sys.stderr)
+                _forward_signal_and_drain(procs, signum, args.preempt_grace)
+                if hb_dir is not None:
+                    shutil.rmtree(hb_dir, ignore_errors=True)
+                report_telemetry()
+                codes = [p.poll() for p in procs]
+                if all(c == 0 for c in codes):
+                    return 0
+                if all(c in (0, EXIT_PREEMPTED) for c in codes):
+                    print("[run] workers preempted gracefully "
+                          "(checkpoints drained)", file=sys.stderr)
+                    return EXIT_PREEMPTED
+                return 128 + signum
             codes = [p.poll() for p in procs]
             suspect, why = [], "failed"
             if any(c not in (None, 0) for c in codes):
@@ -287,7 +386,17 @@ def main(argv=None) -> int:
         if hb_dir is not None:  # each incarnation gets a fresh dir
             shutil.rmtree(hb_dir, ignore_errors=True)
         failed_rank = failed[0]
-        if len(failed) > 1:
+        # Graceful preemption (EXIT_PREEMPTED): restart-worthy — the
+        # checkpoint is durable and training should continue — but NEVER
+        # attributed to the rank. A platform reclaiming capacity says
+        # nothing about the slot's health, so the same-rank tracker that
+        # drives elastic shrink is left untouched (acceptance: preemption
+        # exits are never counted by the shrink tracker).
+        preempted = (why == "failed"
+                     and all(codes[r] == EXIT_PREEMPTED for r in failed))
+        if preempted:
+            why = "preempted (graceful, checkpoint drained)"
+        elif len(failed) > 1:
             # group-wide failure (bad args, rendezvous breakage): never
             # evidence of one bad rank — don't let it drive a shrink
             last_failed, consecutive = None, 0
@@ -295,7 +404,7 @@ def main(argv=None) -> int:
             consecutive = (consecutive + 1 if failed_rank == last_failed
                            else 1)
             last_failed = failed_rank
-        if (args.elastic_min_nproc > 0 and consecutive >= 2
+        if (not preempted and args.elastic_min_nproc > 0 and consecutive >= 2
                 and nproc - 1 >= args.elastic_min_nproc):
             # the same single rank twice in a row: continue smaller. Not
             # charged against --max-restarts — shrinks are bounded by
@@ -308,7 +417,10 @@ def main(argv=None) -> int:
         if restarts >= args.max_restarts:
             print(f"[run] rank {failed_rank} {why}; no restarts left",
                   file=sys.stderr)
-            return 1
+            # a preemption with no restart budget left still exits with
+            # the distinct code so outer schedulers can tell reclaimed
+            # capacity from a genuine failure
+            return EXIT_PREEMPTED if preempted else 1
         restarts += 1
         if (args.elastic_min_nproc > 0 and nproc < args.nproc_per_node
                 and healthy_for >= args.elastic_regrow_after):

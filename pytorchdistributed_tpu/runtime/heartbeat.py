@@ -19,7 +19,9 @@ device values (the log-cadence metrics read), so choose
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 from pathlib import Path
 
 HEARTBEAT_DIR_ENV = "PTD_HEARTBEAT_DIR"
@@ -52,6 +54,34 @@ class Heartbeat:
             os.utime(self.path)
         except FileNotFoundError:
             self.path.touch()
+
+    @contextlib.contextmanager
+    def keepalive(self, interval: float = 1.0):
+        """Background beats while a long blocking host operation runs.
+
+        The graceful-preemption path blocks on ``CheckpointManager.
+        wait()`` — potentially far longer than the agent's heartbeat
+        timeout — and a rank draining its final durable save must not be
+        re-classified as hung and killed mid-write. A daemon thread
+        touches the liveness file every ``interval`` seconds until the
+        block ends; beats from a thread are honest here because the
+        wrapped operation is host I/O progress, not the async-dispatch
+        illusion the device-sync rule guards against."""
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                self.beat()
+
+        self.beat()
+        t = threading.Thread(target=loop, name="ptd-heartbeat-keepalive",
+                             daemon=True)
+        t.start()
+        try:
+            yield self
+        finally:
+            stop.set()
+            t.join(timeout=interval + 1.0)
 
 
 def stale_ranks(directory: str | os.PathLike, nproc: int, *, timeout: float,

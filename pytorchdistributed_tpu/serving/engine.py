@@ -175,13 +175,14 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens: int,
                  sampling: SamplingParams, stop_ids: tuple[int, ...],
-                 on_token=None):
+                 on_token=None, deadline_s: float | None = None):
         self.id = next(Request._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = max_new_tokens
         self.sampling = sampling
         self.stop_ids = stop_ids
         self.on_token = on_token
+        self.deadline_s = deadline_s
         self.new_tokens: list[int] = []
         self.slot: int | None = None
         self.done = False
@@ -272,23 +273,33 @@ class ServingEngine:
 
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None, stop_ids=None,
-               on_token=None) -> Request:
+               on_token=None, deadline_s: float | None = None) -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
-        steps). ``stop_ids`` accepts a single id or a sequence."""
+        steps). ``stop_ids`` accepts a single id or a sequence.
+        ``deadline_s`` is a wall-clock budget from submission: a request
+        past it — queued or mid-decode — is retired with finish_reason
+        "deadline" (whatever tokens it produced stay delivered) and its
+        slot is freed for the next arrival; the other slots are never
+        disturbed. The robustness knob a serving tier needs under
+        overload — a stuck client budget must shed, not wedge, the
+        engine."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
         if prompt.size + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt_len {prompt.size} + max_new_tokens "
                 f"{max_new_tokens} exceeds max_seq_len "
                 f"{self.cfg.max_seq_len}")
         req = Request(prompt, max_new_tokens, sampling or SamplingParams(),
-                      stop_ids_tuple(stop_ids), on_token)
+                      stop_ids_tuple(stop_ids), on_token,
+                      deadline_s=deadline_s)
         req.submit_time = time.perf_counter()
         self._queue.append(req)
         return req
@@ -297,9 +308,11 @@ class ServingEngine:
     # the scheduler loop
 
     def step(self) -> dict:
-        """One scheduler iteration: admit prefills while slots are free,
-        then ONE decode tick over all slots; deliver + retire from the
-        synced tokens. Returns a small stats dict."""
+        """One scheduler iteration: shed deadline-expired requests, admit
+        prefills while slots are free, then ONE decode tick over all
+        slots; deliver + retire from the synced tokens. Returns a small
+        stats dict."""
+        expired = self._expire_deadlines()
         admitted = 0
         while self._free and self._queue:
             self._admit(self._queue.popleft())
@@ -334,7 +347,31 @@ class ServingEngine:
                     active=len(self._active), queued=len(self._queue),
                     slot_occupancy=round(decoded / self.num_slots, 4))
         return {"admitted": admitted, "decoded": decoded,
-                "active": len(self._active), "queued": len(self._queue)}
+                "expired": expired, "active": len(self._active),
+                "queued": len(self._queue)}
+
+    def _expire_deadlines(self) -> int:
+        """Retire every request past its ``deadline_s`` — still queued
+        (shed before wasting a prefill on it) or resident in a slot (the
+        slot frees for this very step's admissions). The engine keeps
+        serving everything else; each expiry is a telemetry span plus the
+        usual per-request row with the distinct finish reason."""
+        now = time.perf_counter()
+
+        def overdue(req: Request) -> bool:
+            return (req.deadline_s is not None and req.submit_time is not None
+                    and now - req.submit_time >= req.deadline_s)
+
+        expired = ([r for r in self._queue if overdue(r)]
+                   + [r for r in self._active.values() if overdue(r)])
+        if not expired:
+            return 0
+        with self._span("serve/deadline_retire"):
+            for req in expired:
+                if req.slot is None:
+                    self._queue.remove(req)
+                self._retire(req, "deadline")
+        return len(expired)
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         """Step until queue and slots drain (tests / batch-mode use)."""
@@ -442,10 +479,13 @@ class ServingEngine:
         req.done = True
         req.finish_reason = reason
         req.finish_time = time.perf_counter()
-        del self._active[req.slot]
-        self._free.append(req.slot)
-        self._temps[req.slot] = 0.0  # idle slots tick greedy garbage
+        if req.slot is not None:  # deadline-expired in queue: no slot yet
+            del self._active[req.slot]
+            self._free.append(req.slot)
+            self._temps[req.slot] = 0.0  # idle slots tick greedy garbage
         self._stats["completed"] += 1
+        if reason == "deadline":
+            self._stats["deadline_expired"] += 1
         if self.telemetry is not None:
             self.telemetry.request(req)
 
@@ -455,7 +495,7 @@ class ServingEngine:
     def reset_stats(self) -> None:
         self._stats = dict(ticks=0, tick_s=0.0, prefills=0, prefill_s=0.0,
                            decode_tokens=0, occupancy_sum=0.0, completed=0,
-                           ttft_s=[])
+                           deadline_expired=0, ttft_s=[])
 
     @property
     def queue_depth(self) -> int:
@@ -474,6 +514,7 @@ class ServingEngine:
         ttfts = np.asarray(st["ttft_s"], np.float64)
         out = {
             "requests_completed": st["completed"],
+            "deadline_expired": st["deadline_expired"],
             "ticks": st["ticks"],
             "prefills": st["prefills"],
             "decode_tokens_per_s": (

@@ -38,6 +38,16 @@ EVENTS_GLOB = "events_rank*.jsonl"
 METRICS_FILE = "metrics_rank{rank}.jsonl"
 METRICS_GLOB = "metrics_rank*.jsonl"
 
+# Canonical event kinds shared by the emitters (faults/, checkpoint,
+# Trainer) and the readers (report CLI, run.py's per-incarnation
+# summaries) — string constants so a typo'd kind is an import error at
+# the call site, not a silently-unmatched row in the post-mortem.
+EVENT_FAULT = "fault_injected"          # faults/inject.py hooks
+EVENT_RETRY = "io_retry"                # faults/retry.py backoff
+EVENT_PREEMPTED = "preempted"           # Trainer SIGTERM graceful exit
+EVENT_CKPT_QUARANTINED = "ckpt_quarantined"  # integrity verify failed
+EVENT_CKPT_FALLBACK = "ckpt_fallback"   # restore walked back a step
+
 
 class JsonlWriter:
     """Append-only JSONL sink. Lazy (re)open in append mode — safe to

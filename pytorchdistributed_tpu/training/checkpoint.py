@@ -6,15 +6,78 @@ to host 0 — the torch `state_dict` anti-pattern at pod scale), saves run
 async so the train loop isn't blocked, and restore takes abstract
 shardings so a checkpoint written on one mesh can resume on another
 (re-sharding happens inside orbax/XLA on load).
+
+Integrity (the CheckFreq lesson — a checkpoint you can't trust is worse
+than none, because resume=True *prefers* it): after each save commits
+(orbax's tmp-dir rename), a ``ptd_manifest.json`` of per-file sizes +
+SHA-256 digests is written inside the step directory. ``restore()``
+verifies the manifest before reading, and when no explicit step is
+pinned it walks back through ``all_steps()`` newest-first, QUARANTINING
+corrupt steps (moved to ``<dir>/quarantine/``, never deleted — they are
+post-mortem evidence) until a verified checkpoint loads — so a torn or
+bit-flipped latest save costs one checkpoint interval, not the job.
+Save/restore I/O is retried with bounded backoff (``faults.retry``)
+before a transient filesystem error is allowed to kill an incarnation.
+Offline: ``python -m pytorchdistributed_tpu.training.checkpoint verify
+<dir>`` checks every step of a directory and exits nonzero on corruption.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
+import os
 import pathlib
+import time
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from pytorchdistributed_tpu.faults import inject as _inject
+from pytorchdistributed_tpu.faults.retry import IO_RETRY, RetryPolicy, retry
+from pytorchdistributed_tpu.telemetry.events import (
+    EVENT_CKPT_FALLBACK,
+    EVENT_CKPT_QUARANTINED,
+    EventLog,
+)
+
+MANIFEST_NAME = "ptd_manifest.json"
+QUARANTINE_DIR = "quarantine"
+
+# Files the manifest must NOT cover: the manifest itself, and orbax's
+# step-metadata sidecar — orbax appends commit_timestamp_nsecs to it in
+# its own finalize step, which can land after the commit rename our
+# flush keys on; hashing a file the writer still legitimately mutates
+# would flag healthy checkpoints as corrupt (observed racing once in
+# ~10 manual runs). Payload integrity (tensorstore data + tree
+# metadata) is fully covered without it.
+_MANIFEST_EXCLUDE = frozenset({MANIFEST_NAME, "_CHECKPOINT_METADATA"})
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """An explicitly-requested step failed manifest verification."""
+
+
+@dataclasses.dataclass(frozen=True)
+class StepVerdict:
+    """verify_step's answer: ``ok`` is False only on positive evidence of
+    corruption; ``verified`` distinguishes a matching manifest from a
+    legacy step that has none to check against."""
+
+    step: int
+    ok: bool
+    verified: bool
+    detail: str
+
+
+def _hash_file(path: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class CheckpointManager:
@@ -27,7 +90,8 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str | pathlib.Path, *,
-                 max_to_keep: int | None = 3, save_interval_steps: int = 1):
+                 max_to_keep: int | None = 3, save_interval_steps: int = 1,
+                 retry_policy: RetryPolicy = IO_RETRY):
         self.directory = pathlib.Path(directory).absolute()
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -37,21 +101,186 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        self._retry_policy = retry_policy
+        # steps whose async save has been started but whose integrity
+        # manifest is not yet on disk — flushed when the commit (orbax's
+        # tmp-dir rename) is observed
+        self._pending_manifest: set[int] = set()
+        self._events = EventLog.from_env(int(os.environ.get("RANK", "0")))
+
+    # -- paths -------------------------------------------------------------
+
+    def step_dir(self, step: int) -> pathlib.Path:
+        return self.directory / str(step)
+
+    def _manifest_path(self, step: int) -> pathlib.Path:
+        return self.step_dir(step) / MANIFEST_NAME
+
+    # -- save ---------------------------------------------------------------
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        """Async sharded save; returns whether a save was started."""
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+        """Async sharded save; returns whether a save was started. I/O
+        errors at dispatch are retried per the policy; earlier saves that
+        have committed since get their manifests flushed here, so a
+        long-running loop doesn't defer all integrity work to wait()."""
+        self._flush_manifests()
+        inj = _inject.active()
+
+        def attempt() -> bool:
+            if inj is not None:
+                inj.on_io("checkpoint_save", step=step)
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force)
+
+        started = retry(attempt, policy=self._retry_policy,
+                        describe=f"checkpoint save step {step}",
+                        events=self._events)
+        if started:
+            self._pending_manifest.add(step)
+        return started
+
+    # -- integrity ----------------------------------------------------------
+
+    def _flush_manifests(self, *, all_committed: bool = False) -> None:
+        """Write manifests for pending steps whose commit rename has
+        landed. ``all_committed``: every pending save is known durable
+        (post wait()), so a pending step with no directory was GC'd by
+        max_to_keep and is dropped. Manifest writing is rank-0-only (one
+        writer per shared directory), and the ckpt_corrupt injection hook
+        fires on that SAME rank immediately after its manifest write —
+        cross-process ordering between a sibling rank's bit-flip and the
+        hash computation is otherwise undefined, and corruption hashed
+        INTO the manifest would verify clean, inverting the fault's
+        bit-flipped-AFTER-manifest contract."""
+        from pytorchdistributed_tpu.runtime import dist
+
+        inj = _inject.active()
+        for step in sorted(self._pending_manifest):
+            sdir = self.step_dir(step)
+            if not sdir.is_dir():
+                if all_committed:
+                    self._pending_manifest.discard(step)
+                continue
+            if dist.is_main_process():
+                self.write_manifest(step)
+                if inj is not None:
+                    inj.on_checkpoint_saved(step, sdir)
+            self._pending_manifest.discard(step)
+
+    def write_manifest(self, step: int) -> pathlib.Path:
+        """Per-file size + SHA-256 manifest for a COMMITTED step,
+        written atomically (tmp + rename) beside the data it covers."""
+        sdir = self.step_dir(step)
+        files = {}
+        for p in sorted(sdir.rglob("*")):
+            if not p.is_file() or p.name in _MANIFEST_EXCLUDE:
+                continue
+            rel = str(p.relative_to(sdir))
+            files[rel] = {"size": p.stat().st_size, "sha256": _hash_file(p)}
+        manifest = {"step": step, "time": round(time.time(), 3),
+                    "files": files}
+        path = self._manifest_path(step)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(manifest, indent=0, sort_keys=True))
+        os.replace(tmp, path)
+        return path
+
+    def verify_step(self, step: int) -> StepVerdict:
+        """Check a committed step against its manifest. A step with NO
+        manifest passes unverified (legacy saves, or a rank that died
+        after commit but before the rank-0 manifest write — orbax's
+        commit rename already guarantees the data is whole); a manifest
+        that exists and mismatches is positive evidence of corruption."""
+        sdir = self.step_dir(step)
+        if not sdir.is_dir():
+            return StepVerdict(step, False, False, "missing step directory")
+        return _verify_step_dir(step, sdir)
+
+    def quarantine(self, step: int, *, reason: str = "") -> pathlib.Path:
+        """Move a corrupt step out of orbax's sight (``quarantine/<step>``
+        — evidence, not garbage) and refresh the manager's step cache.
+        Concurrency-tolerant: on a shared directory every resuming rank
+        walks the same fallback chain, so losing the os.replace race to a
+        sibling rank is success, not an error."""
+        qdir = self.directory / QUARANTINE_DIR
+        qdir.mkdir(exist_ok=True)
+        dest = qdir / str(step)
+        if dest.exists():  # a prior incarnation quarantined this step too
+            dest = qdir / f"{step}.{int(time.time() * 1e3)}"
+        try:
+            os.replace(self.step_dir(step), dest)
+        except FileNotFoundError:
+            dest = qdir / str(step)  # a sibling rank moved it first
+        self._mgr.reload()
+        if self._events is not None:
+            self._events.emit(EVENT_CKPT_QUARANTINED, step=step,
+                              reason=reason[:200], moved_to=str(dest))
+        return dest
+
+    # -- restore ------------------------------------------------------------
 
     def restore(self, abstract_state: Any, *, step: int | None = None) -> Any:
-        """Restore ``step`` (default: latest) onto the shardings carried by
-        ``abstract_state``."""
-        step = self.latest_step() if step is None else step
+        """Restore ``step`` onto the shardings carried by
+        ``abstract_state``. An explicit ``step`` is strict: verification
+        failure raises CheckpointIntegrityError (the caller pinned it for
+        a reason — silently answering with a different step would lie).
+        ``step=None`` walks the verified-fallback chain
+        (restore_verified)."""
         if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+            state, _ = self.restore_verified(abstract_state)
+            return state
+        verdict = self.verify_step(step)
+        if not verdict.ok:
+            raise CheckpointIntegrityError(
+                f"checkpoint step {step} under {self.directory} failed "
+                f"verification: {verdict.detail}")
+        return self._restore_step(step, abstract_state)
+
+    def restore_verified(self, abstract_state: Any) -> tuple[Any, int]:
+        """The fallback chain: newest step first, verify → restore;
+        corrupt steps (manifest mismatch, or an unreadable-on-disk
+        checkpoint) are quarantined and the walk continues — the last
+        verified checkpoint wins. Returns (state, step)."""
+        self._flush_manifests()
+        newest = self.latest_step()
+        while True:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.directory} survived "
+                    f"verification (see {QUARANTINE_DIR}/)"
+                    if newest is not None else
+                    f"no checkpoint found under {self.directory}")
+            verdict = self.verify_step(step)
+            if not verdict.ok:
+                self.quarantine(step, reason=verdict.detail)
+                continue
+            try:
+                state = self._restore_step(step, abstract_state)
+            except Exception as e:  # noqa: BLE001 — filtered below
+                if not _is_data_corruption(e):
+                    raise
+                self.quarantine(step, reason=f"restore failed: {e}"[:200])
+                continue
+            if step != newest and self._events is not None:
+                self._events.emit(EVENT_CKPT_FALLBACK, step=step,
+                                  skipped_newest=newest)
+            return state, step
+
+    def _restore_step(self, step: int, abstract_state: Any) -> Any:
+        inj = _inject.active()
+
+        def attempt():
+            if inj is not None:
+                inj.on_io("checkpoint_restore", step=step)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
+
+        return retry(attempt, policy=self._retry_policy,
+                     describe=f"checkpoint restore step {step}",
+                     events=self._events)
+
+    # -- bookkeeping ---------------------------------------------------------
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -61,8 +290,11 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until in-flight async saves are durable (call before exit
-        and in tests)."""
+        and in tests); manifests for the committed saves are written here,
+        so after wait() returns the newest checkpoint is both durable AND
+        verifiable — the preemption handler's contract."""
         self._mgr.wait_until_finished()
+        self._flush_manifests(all_committed=True)
 
     def close(self) -> None:
         self._mgr.close()
@@ -75,8 +307,92 @@ class CheckpointManager:
         self.close()
 
 
+def _is_data_corruption(e: BaseException) -> bool:
+    """Restore exceptions that indicate on-disk damage (walk back) vs
+    caller error like a mismatched abstract tree (re-raise). Orbax
+    surfaces tensorstore corruption as ValueError with status-code text;
+    OSError covers torn metadata reads."""
+    if isinstance(e, (OSError, json.JSONDecodeError)):
+        return True
+    text = str(e)
+    return any(tag in text for tag in
+               ("DATA_LOSS", "NOT_FOUND", "FAILED_PRECONDITION",
+                "Error reading", "Error opening"))
+
+
 def abstract_state_like(state, state_shardings):
     """ShapeDtypeStruct tree carrying the target shardings, for restore."""
     return jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         state, state_shardings)
+
+
+def verify_directory(directory: str | pathlib.Path) -> list[StepVerdict]:
+    """Offline integrity sweep of every step under ``directory`` (the
+    CLI's engine; no device work, no orbax restore — manifest checks
+    only)."""
+    directory = pathlib.Path(directory)
+    verdicts = []
+    for entry in sorted(directory.iterdir() if directory.is_dir() else [],
+                        key=lambda p: (len(p.name), p.name)):
+        if entry.is_dir() and entry.name.isdigit():
+            verdicts.append(_verify_step_dir(int(entry.name), entry))
+    return verdicts
+
+
+def _verify_step_dir(step: int, sdir: pathlib.Path) -> StepVerdict:
+    """Manifest check against one step directory (shared by
+    CheckpointManager.verify_step's logic and the standalone CLI)."""
+    mpath = sdir / MANIFEST_NAME
+    if not mpath.exists():
+        return StepVerdict(step, True, False, "no manifest (unverified)")
+    try:
+        entries = dict(json.loads(mpath.read_text())["files"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return StepVerdict(step, False, False, f"unreadable manifest ({e})")
+    for rel, meta in entries.items():
+        p = sdir / rel
+        if not p.is_file():
+            return StepVerdict(step, False, True, f"missing file {rel}")
+        if p.stat().st_size != meta.get("size"):
+            return StepVerdict(step, False, True, f"size mismatch {rel}")
+        if _hash_file(p) != meta.get("sha256"):
+            return StepVerdict(step, False, True, f"checksum mismatch {rel}")
+    return StepVerdict(step, True, True, f"{len(entries)} files ok")
+
+
+def main(argv=None) -> int:
+    """``python -m pytorchdistributed_tpu.training.checkpoint verify
+    <dir>``: offline integrity report, exit 1 when any step is corrupt
+    (unverified legacy steps report but do not fail)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "pytorchdistributed_tpu.training.checkpoint")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("verify",
+                       help="check every step's integrity manifest")
+    v.add_argument("directory")
+    v.add_argument("--strict", action="store_true",
+                   help="also fail on steps with no manifest to check")
+    args = parser.parse_args(argv)
+
+    verdicts = verify_directory(args.directory)
+    if not verdicts:
+        print(f"no checkpoint steps under {args.directory}")
+        return 1
+    bad = 0
+    for vd in verdicts:
+        status = ("OK" if vd.ok and vd.verified
+                  else "UNVERIFIED" if vd.ok else "CORRUPT")
+        if not vd.ok or (args.strict and not vd.verified):
+            bad += 1
+        print(f"step {vd.step:>8}  {status:<10}  {vd.detail}")
+    print(f"{len(verdicts)} step(s), {bad} bad")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

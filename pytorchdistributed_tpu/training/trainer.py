@@ -21,6 +21,8 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import signal
+import threading
 import time
 from functools import partial
 from pathlib import Path
@@ -35,6 +37,8 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pytorchdistributed_tpu.data.loader import prefetch_to_device
+from pytorchdistributed_tpu.faults import inject as _faults_inject
+from pytorchdistributed_tpu.faults.inject import EXIT_PREEMPTED
 from pytorchdistributed_tpu.parallel.precision import Policy
 from pytorchdistributed_tpu.parallel.sharding import shardings_for_strategy
 from pytorchdistributed_tpu.runtime import dist
@@ -48,7 +52,11 @@ from pytorchdistributed_tpu.telemetry import (
     SpanTracer,
     device_memory_highwater,
 )
-from pytorchdistributed_tpu.telemetry.events import EVENTS_FILE, METRICS_FILE
+from pytorchdistributed_tpu.telemetry.events import (
+    EVENT_PREEMPTED,
+    EVENTS_FILE,
+    METRICS_FILE,
+)
 from pytorchdistributed_tpu.telemetry.spans import SPAN_TRACE_FILE
 from pytorchdistributed_tpu.training.logging import JsonlWriter, MetricLogger
 from pytorchdistributed_tpu.utils.guards import (
@@ -257,6 +265,16 @@ class Trainer:
         # epoch end) — host-loop progress alone proves nothing under async
         # dispatch (see runtime/heartbeat.py).
         self._heartbeat = Heartbeat.from_env()
+        # Deterministic fault injection (faults/inject.py): None unless
+        # the PTD_FAULTS env spec is set (run.py --faults). The hot loop
+        # pays one `is None` check per step when off.
+        self._faults = _faults_inject.active()
+        # Graceful-preemption state: fit() installs a SIGTERM handler
+        # (main thread only) that flips this flag; the step loop then
+        # finishes the in-flight step, forces a durable checkpoint and
+        # exits EXIT_PREEMPTED — the contract run.py's agent recognizes
+        # as restart-worthy but never rank-attributable.
+        self._preempt_requested = False
         self._meter = ThroughputMeter()
         self.profile_dir = profile_dir
         self._profiling = False
@@ -759,6 +777,13 @@ class Trainer:
                     # derived metrics exactly on the runs telemetry is
                     # meant to post-mortem
                     self._maybe_build_accounting(batch)
+                # 1-based optimizer step this iteration will run, global
+                # across incarnations (resume keeps epoch/skip aligned
+                # with state.step) — the coordinate PTD_FAULTS specs and
+                # the preemption record are expressed in
+                gstep = epoch * self._steps_per_epoch + i + 1
+                if self._faults is not None:
+                    self._faults.on_step(gstep)
                 self._maybe_profile(epoch, i)
                 if self._profiling:
                     # step annotations ride the capture so utils/trace.py
@@ -774,6 +799,11 @@ class Trainer:
                         metrics = self.train_step(batch)
                 else:
                     metrics = self.train_step(batch)
+                if (self._faults is not None
+                        and self._faults.poison_nan(gstep)):
+                    # injected numeric blowup: the tripwire must record
+                    # it and the watchdog must raise at the next log sync
+                    metrics = {**metrics, "loss": float("nan")}
                 n = self._batch_samples(batch)
                 self._meter.update(n)
                 self._last_batch_samples = n
@@ -805,6 +835,10 @@ class Trainer:
                         and (i + 1) % self._checkpoint_every == 0):
                     with self._span("checkpoint"):
                         self._save_checkpoint()
+                if self._preempt_requested:
+                    # the current step is finished — honor the SIGTERM
+                    # now: durable checkpoint, then the distinct exit
+                    self._graceful_preempt(epoch, gstep)
         finally:
             # teardown runs on the exception path too: an open profiler
             # capture is closed, the JSONL sinks are flushed+closed (a
@@ -1070,23 +1104,82 @@ class Trainer:
         land on the same step as the last interval save). A JSON sidecar
         records steps_per_epoch so resume can detect a changed loader
         geometry (different batch size / replica count) instead of silently
-        skipping the wrong number of batches."""
+        skipping the wrong number of batches. The sidecar is written
+        atomically (temp + os.replace): a rank killed mid-write must leave
+        either the whole meta file or none — a truncated one would brick
+        the very resume it exists to guard."""
         step = int(self.state.step)
         if step in self.checkpoint.all_steps():
             return
         if self.checkpoint.save(step, self.state, force=force) \
                 and self._steps_per_epoch and dist.is_main_process():
             meta = {"steps_per_epoch": self._steps_per_epoch}
-            (self.checkpoint.directory / f"trainer_meta_{step}.json"
-             ).write_text(json.dumps(meta))
+            path = self.checkpoint.directory / f"trainer_meta_{step}.json"
+            tmp = path.with_name(path.name + ".tmp")
+            tmp.write_text(json.dumps(meta))
+            os.replace(tmp, path)
+
+    # -- preemption --------------------------------------------------------
+
+    def _on_sigterm(self, signum, frame) -> None:
+        """Signal handler: flag only — all real work (device sync,
+        checkpoint I/O) happens at the next safe point in the step loop,
+        never inside the handler."""
+        self._preempt_requested = True
+
+    def _install_preempt_handler(self):
+        """SIGTERM → graceful preemption while fit() runs (TPU preemption
+        notice / run.py --preempt-grace forwarding). Returns a restore
+        callback; no-op off the main thread (signal API limitation) and
+        under callers that already own SIGTERM."""
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        try:
+            prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError:  # pragma: no cover - non-main interpreter state
+            return lambda: None
+        return lambda: signal.signal(signal.SIGTERM, prev)
+
+    def _graceful_preempt(self, epoch: int, step: int) -> None:
+        """The SIGTERM contract: the current step has completed — record
+        the preemption, force a checkpoint, block until it is durable
+        (keepalive beats so the agent's hung-rank detector doesn't kill
+        the drain), then exit with the distinct PREEMPTED code the
+        launcher never charges to the same-rank failure tracker."""
+        self.logger.info(
+            f"preempted (SIGTERM) at step {step}; draining checkpoint")
+        if self._events is not None:
+            self._events.emit(EVENT_PREEMPTED, step=step, epoch=epoch)
+            self._events.flush()
+        if self.checkpoint is not None:
+            hb = (self._heartbeat.keepalive()
+                  if self._heartbeat is not None
+                  else contextlib.nullcontext())
+            with hb, self._span("preempt_checkpoint"):
+                self._save_checkpoint(force=True)
+                self.checkpoint.wait()
+        raise SystemExit(EXIT_PREEMPTED)
 
     def fit(self, loader, max_epochs: int, *,
             resume: bool = False, val_loader=None) -> dict[str, float]:
         """The reference's ``train`` (ddp_gpus.py:53-55), plus
         checkpoint/resume (SURVEY.md §5): with a checkpoint_dir configured,
         every epoch end saves the sharded state async, and ``resume=True``
-        continues from the latest step. ``val_loader`` runs evaluate() at
+        continues from the latest VERIFIED step — a corrupt newest
+        checkpoint is quarantined and the previous one loads instead of
+        the run dying. While fit runs, SIGTERM means preemption: the
+        current step finishes, a checkpoint is forced durable, and the
+        process exits EXIT_PREEMPTED. ``val_loader`` runs evaluate() at
         every epoch end; its metrics land in the return dict as val_*."""
+        restore_handler = self._install_preempt_handler()
+        try:
+            return self._fit(loader, max_epochs, resume=resume,
+                             val_loader=val_loader)
+        finally:
+            restore_handler()
+
+    def _fit(self, loader, max_epochs: int, *,
+             resume: bool, val_loader) -> dict[str, float]:
         start_epoch, skip = 0, 0
         if resume:
             if self.checkpoint is None:
@@ -1142,8 +1235,7 @@ class Trainer:
 
         if self.checkpoint is None:
             raise ValueError("restore() needs a checkpoint_dir")
-        target = step if step is not None else self.checkpoint.latest_step()
-        if target is None:
+        if step is None and self.checkpoint.latest_step() is None:
             raise ValueError(
                 f"no checkpoint under {self.checkpoint.directory}")
         if self.state is None:
@@ -1159,9 +1251,24 @@ class Trainer:
             self.state = None  # free the live buffers BEFORE orbax
             # allocates the restored state — otherwise a model sized near
             # HBM capacity holds 2x params+opt_state during the load
-        self.state = self.checkpoint.restore(
-            abstract_state_like(abstract, self.state_shardings),
-            step=target)
+        abstract_sharded = abstract_state_like(abstract, self.state_shardings)
+        if step is not None:
+            # pinned step: strict — verification failure raises rather
+            # than silently answering with a different checkpoint
+            self.state = self.checkpoint.restore(abstract_sharded, step=step)
+        else:
+            # default: the verified-fallback chain — corrupt steps are
+            # quarantined and the walk continues to the last good one
+            newest = self.checkpoint.latest_step()
+            try:
+                self.state, restored = self.checkpoint.restore_verified(
+                    abstract_sharded)
+            except FileNotFoundError as e:
+                raise ValueError(str(e)) from None
+            if restored != newest and dist.is_main_process():
+                self.logger.info(
+                    f"restore fell back to step {restored} (newest step "
+                    f"{newest} failed verification; quarantined)")
         # The train step builds lazily on the first train_step() — eager
         # building here would let train-only guards (accum x 1f1b, dropout
         # in pipelines) break inference-only restores.
@@ -1171,27 +1278,42 @@ class Trainer:
         return self.state
 
     def _resume(self, loader) -> tuple[int, int]:
-        """Restore the latest checkpoint (re-sharding onto the current mesh
-        if it differs from the saving run's). Returns (epoch to resume at,
-        batches of that epoch to skip) — a mid-epoch checkpoint fast-forwards
-        past the already-trained prefix so no batch is trained twice."""
-        step = self.checkpoint.latest_step()
-        meta_path = self.checkpoint.directory / f"trainer_meta_{step}.json"
-        if meta_path.exists():
-            saved = json.loads(meta_path.read_text()).get("steps_per_epoch")
-            if saved and saved != len(loader):
-                raise ValueError(
-                    f"checkpoint at step {step} was written with "
-                    f"steps_per_epoch={saved} but the current loader has "
-                    f"{len(loader)} — resuming would skip the wrong batches "
-                    f"or retrain duplicates; use the same batch size and "
-                    f"replica count as the saving run")
+        """Restore the latest VERIFIED checkpoint (re-sharding onto the
+        current mesh if it differs from the saving run's; corrupt steps
+        fall back — see restore()). Returns (epoch to resume at, batches
+        of that epoch to skip) — a mid-epoch checkpoint fast-forwards
+        past the already-trained prefix so no batch is trained twice.
+        The geometry guard runs against the step that actually restored:
+        a missing or torn trainer_meta sidecar downgrades to a warning
+        (the state itself is integrity-checked; losing the sidecar must
+        not brick resume), a PRESENT sidecar that contradicts the loader
+        still raises."""
         if self.state is None:  # restore() only reads the batch in this case
             loader.set_epoch(0)
-            self.restore(next(iter(loader)), step=step)
+            self.restore(next(iter(loader)))
         else:
-            self.restore(step=step)
+            self.restore()
         step = int(self.state.step)
+        meta_path = self.checkpoint.directory / f"trainer_meta_{step}.json"
+        saved = None
+        try:
+            saved = json.loads(meta_path.read_text()).get("steps_per_epoch")
+        except FileNotFoundError:
+            self.logger.info(
+                f"WARNING: no trainer_meta_{step}.json sidecar; skipping "
+                f"the loader-geometry check for this resume")
+        except (OSError, ValueError):
+            self.logger.info(
+                f"WARNING: unreadable trainer_meta_{step}.json (torn "
+                f"write?); skipping the loader-geometry check for this "
+                f"resume")
+        if saved and saved != len(loader):
+            raise ValueError(
+                f"checkpoint at step {step} was written with "
+                f"steps_per_epoch={saved} but the current loader has "
+                f"{len(loader)} — resuming would skip the wrong batches "
+                f"or retrain duplicates; use the same batch size and "
+                f"replica count as the saving run")
         steps_per_epoch = max(len(loader), 1)
         start_epoch = step // steps_per_epoch
         skip = step % steps_per_epoch

@@ -1,0 +1,674 @@
+"""Chaos suite (SURVEY.md §5 completion): deterministic fault injection,
+retry/backoff, checkpoint integrity + fallback, preemption.
+
+Tiering: the spec/retry/injector units and the two single-process
+injection tests (nan trip, corrupt-latest fallback) ride the quick tier
+(conftest._QUICK); everything driving real ``run.py`` multi-process runs
+— crash→resume loss continuity, hang→heartbeat relaunch, repeated
+crash→elastic shrink, preemption→uncharged restart, corrupt
+latest→fallback resume, SIGTERM forwarding — stays full-suite-only.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from pytorchdistributed_tpu.faults import (
+    FaultInjector,
+    FaultPlan,
+    RetryPolicy,
+    retry,
+)
+from pytorchdistributed_tpu.faults import inject as finject
+from pytorchdistributed_tpu.telemetry.events import (
+    EVENT_FAULT,
+    EVENT_RETRY,
+    EventLog,
+    read_events,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def faults_env(monkeypatch):
+    """Set a PTD_FAULTS plan for the duration of one test and re-resolve
+    the process-global injector; everything is undone at teardown so the
+    rest of the suite sees no plan."""
+
+    def activate(spec, state_dir=None):
+        monkeypatch.setenv(finject.FAULTS_ENV, spec)
+        if state_dir is not None:
+            monkeypatch.setenv(finject.FAULTS_STATE_ENV, str(state_dir))
+        finject.reset_active()
+        return finject.active()
+
+    yield activate
+    finject.reset_active()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+
+
+class TestFaultPlan:
+    def test_parses_full_issue_spec(self):
+        plan = FaultPlan.parse(
+            "crash@step=7,rank=1; hang@step=12,rank=0; nan@step=9; "
+            "preempt@step=15; ckpt_corrupt@step=20; slow_io@p=0.3,ms=200; "
+            "io_err@p=1,n=2")
+        kinds = [s.kind for s in plan.specs]
+        assert kinds == ["crash", "hang", "nan", "preempt", "ckpt_corrupt",
+                         "slow_io", "io_err"]
+        crash = plan.specs[0]
+        assert (crash.step, crash.rank) == (7, 1)
+        slow = plan.specs[5]
+        assert (slow.p, slow.ms) == (0.3, 200.0)
+        assert plan.specs[6].n == 2
+
+    def test_empty_entries_and_whitespace_tolerated(self):
+        assert len(FaultPlan.parse(" crash@step=1 ; ; nan@step=2;")) == 2
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan.parse("explode@step=3")
+
+    def test_step_kind_without_step_raises(self):
+        with pytest.raises(ValueError, match="needs step="):
+            FaultPlan.parse("crash@rank=1")
+
+    def test_bad_param_raises(self):
+        with pytest.raises(ValueError, match="bad fault param"):
+            FaultPlan.parse("crash@step=banana")
+        with pytest.raises(ValueError, match="bad fault param"):
+            FaultPlan.parse("slow_io@volume=11")
+
+    def test_probability_bounds(self):
+        with pytest.raises(ValueError, match="p must be"):
+            FaultPlan.parse("io_err@p=1.5")
+
+    def test_from_env(self, faults_env):
+        inj = faults_env("nan@step=4")
+        assert inj is not None and inj.plan.specs[0].kind == "nan"
+        finject.reset_active()
+        os.environ.pop(finject.FAULTS_ENV, None)
+        assert FaultPlan.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls, sleeps = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry(flaky, policy=RetryPolicy(max_attempts=4,
+                                              base_delay_s=0.01),
+                    sleep=sleeps.append)
+        assert out == "ok" and len(calls) == 3 and len(sleeps) == 2
+
+    def test_backoff_grows_exponentially_with_bounded_jitter(self):
+        import random
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.1, backoff=2.0,
+                             max_delay_s=0.3, jitter=0.25)
+        rng = random.Random(0)
+        d1, d2, d3, d4 = (policy.delay(k, rng) for k in (1, 2, 3, 4))
+        assert 0.1 <= d1 <= 0.125
+        assert 0.2 <= d2 <= 0.25
+        assert 0.3 <= d3 <= 0.375  # capped at max_delay_s pre-jitter
+        assert 0.3 <= d4 <= 0.375
+
+    def test_exhausted_attempts_raise_original(self):
+        def always():
+            raise OSError("permanent-ish")
+
+        with pytest.raises(OSError, match="permanent-ish"):
+            retry(always, policy=RetryPolicy(max_attempts=3,
+                                             base_delay_s=0.001),
+                  sleep=lambda s: None)
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def wrong():
+            calls.append(1)
+            raise ValueError("caller bug")
+
+        with pytest.raises(ValueError):
+            retry(wrong, sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_each_retry_is_a_durable_event(self, tmp_path):
+        events = EventLog(tmp_path / "events_rank0.jsonl", rank=0)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise OSError("blip")
+            return 1
+
+        retry(flaky, policy=RetryPolicy(max_attempts=4, base_delay_s=0.001),
+              describe="unit read", events=events, sleep=lambda s: None)
+        events.close()
+        evs = read_events(tmp_path)
+        assert [e.kind for e in evs] == [EVENT_RETRY, EVENT_RETRY]
+        assert evs[0].data["op"] == "unit read"
+        assert evs[0].data["attempt"] == 1 and evs[1].data["attempt"] == 2
+
+
+# ---------------------------------------------------------------------------
+# injector mechanics
+
+
+class TestInjector:
+    def test_rank_filter(self):
+        plan = FaultPlan.parse("io_err@p=1,rank=1")
+        FaultInjector(plan, rank=0).on_io("x")  # not my rank: no raise
+        with pytest.raises(OSError, match="injected io_err"):
+            FaultInjector(plan, rank=1).on_io("x")
+
+    def test_io_err_count_cap(self):
+        inj = FaultInjector(FaultPlan.parse("io_err@p=1,n=2"), rank=0)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                inj.on_io("read")
+        inj.on_io("read")  # cap reached: clean from here on
+
+    def test_slow_io_sleeps(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(finject.time, "sleep", slept.append)
+        inj = FaultInjector(FaultPlan.parse("slow_io@p=1,ms=123"), rank=0)
+        inj.on_io("read")
+        assert slept == [pytest.approx(0.123)]
+
+    def test_slow_io_probability_deterministic_per_rank(self):
+        plan = FaultPlan.parse("io_err@p=0.5,n=0")
+
+        def failures(rank):
+            inj = FaultInjector(plan, rank=rank)
+            n = 0
+            for _ in range(64):
+                try:
+                    inj.on_io("read")
+                except OSError:
+                    n += 1
+            return n
+
+        a, b = failures(0), failures(0)
+        assert a == b  # same seed, same draw sequence
+        assert 8 < a < 56  # and it actually mixes
+
+    def test_poison_nan_is_one_shot(self):
+        inj = FaultInjector(FaultPlan.parse("nan@step=3"), rank=0)
+        assert not inj.poison_nan(2)
+        assert inj.poison_nan(3)
+        assert not inj.poison_nan(3)  # marker consumed
+
+    def test_one_shot_markers_survive_reincarnation(self, tmp_path):
+        """Two injector instances over the same state dir model two
+        incarnations of a relaunched worker: the second must NOT re-fire
+        a step fault the first already fired (the infinite-crash-loop
+        guard)."""
+        plan = FaultPlan.parse("nan@step=5")
+        first = FaultInjector(plan, rank=0, state_dir=str(tmp_path))
+        assert first.poison_nan(5)
+        second = FaultInjector(plan, rank=0, state_dir=str(tmp_path))
+        assert not second.poison_nan(5)
+
+    def test_injections_emit_events(self, tmp_path):
+        events = EventLog(tmp_path / "events_rank0.jsonl", rank=0)
+        inj = FaultInjector(FaultPlan.parse("nan@step=1; io_err@p=1,n=1"),
+                            rank=0, events=events)
+        assert inj.poison_nan(1)
+        with pytest.raises(OSError):
+            inj.on_io("read")
+        evs = read_events(tmp_path)
+        assert [e.kind for e in evs] == [EVENT_FAULT, EVENT_FAULT]
+        assert {e.data["fault"] for e in evs} == {"nan", "io_err"}
+
+
+# ---------------------------------------------------------------------------
+# single-process injection through the Trainer / data / checkpoint layers
+# (the quick-tier representatives)
+
+
+def _reg_trainer(tmp_path=None, **kw):
+    from pytorchdistributed_tpu.models import MLP
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+    return Trainer(MLP(features=(16, 1)), optax.sgd(0.05), mse_loss,
+                   mesh=create_mesh(), **kw)
+
+
+def _reg_loader(size=32, batch=8):
+    from pytorchdistributed_tpu.data import (
+        DataLoader,
+        SyntheticRegressionDataset,
+    )
+
+    ds = SyntheticRegressionDataset(size=size, in_dim=8, out_dim=1, seed=0)
+    return DataLoader(ds, batch_size=batch, num_replicas=1, rank=0, seed=0)
+
+
+def test_nan_injection_trips_watchdog(tmp_path, faults_env, monkeypatch):
+    """nan@step poisons the loss; the tripwire records a durable event
+    BEFORE the watchdog raises — post-mortem first, halt second."""
+    monkeypatch.setenv("PTD_TELEMETRY_DIR", str(tmp_path / "tele"))
+    faults_env("nan@step=3")
+    tr = _reg_trainer(telemetry_dir=str(tmp_path / "tele"), log_every=1)
+    with pytest.raises(FloatingPointError, match="loss"):
+        tr.fit(_reg_loader(), max_epochs=1)
+    kinds = [e.kind for e in read_events(tmp_path / "tele")]
+    assert EVENT_FAULT in kinds, kinds
+    assert "non_finite_metric" in kinds, kinds
+    # the injection fired at exactly the configured step
+    ev = next(e for e in read_events(tmp_path / "tele")
+              if e.kind == EVENT_FAULT)
+    assert ev.step == 3 and ev.data["fault"] == "nan"
+
+
+def test_corrupt_latest_checkpoint_falls_back(tmp_path):
+    """Integrity chain end to end, single process: corrupt the newest
+    step's payload → offline verify flags it, a pinned restore refuses
+    it, and the default restore quarantines it and loads the previous
+    verified step."""
+    from pytorchdistributed_tpu.training import checkpoint as ckpt_mod
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointIntegrityError,
+    )
+
+    loader = _reg_loader()
+    tr = _reg_trainer(checkpoint_dir=str(tmp_path / "ck"))
+    loader.set_epoch(0)
+    for i, batch in enumerate(iter(loader)):
+        tr.train_step(batch)
+        if i in (1, 3):
+            tr._save_checkpoint(force=True)
+    tr.checkpoint.wait()  # durable + manifests written
+    assert tr.checkpoint.all_steps() == [2, 4]
+    for step in (2, 4):
+        v = tr.checkpoint.verify_step(step)
+        assert v.ok and v.verified, v
+
+    # flip bytes in step 4's largest payload file (manifest untouched)
+    sdir = tmp_path / "ck" / "4"
+    target = max((p for p in sdir.rglob("*")
+                  if p.is_file() and "manifest" not in p.name.lower()),
+                 key=lambda p: p.stat().st_size)
+    data = bytearray(target.read_bytes())
+    for j in range(min(64, len(data))):
+        data[j] ^= 0xFF
+    target.write_bytes(bytes(data))
+
+    # offline CLI: reports the corruption, exit 1
+    assert ckpt_mod.main(["verify", str(tmp_path / "ck")]) == 1
+    v = tr.checkpoint.verify_step(4)
+    assert not v.ok and v.verified and "mismatch" in v.detail
+
+    # pinned restore is strict
+    fresh = _reg_trainer(checkpoint_dir=str(tmp_path / "ck"))
+    loader.set_epoch(0)
+    batch = next(iter(loader))
+    with pytest.raises(CheckpointIntegrityError, match="step 4"):
+        fresh.restore(batch, step=4)
+
+    # default restore falls back to the last verified step + quarantines
+    state = fresh.restore(batch)
+    assert int(state.step) == 2
+    assert fresh.checkpoint.all_steps() == [2]
+    assert (tmp_path / "ck" / "quarantine" / "4").is_dir()
+    # and the post-quarantine directory verifies clean
+    assert ckpt_mod.main(["verify", str(tmp_path / "ck")]) == 0
+
+
+def test_ckpt_corrupt_injection_and_fallback(tmp_path, faults_env):
+    """The ckpt_corrupt injection hook: fires once when the matching
+    step's save commits + manifest lands, and the fallback walk then
+    restores the previous step."""
+    import jax
+
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointManager,
+        abstract_state_like,
+    )
+
+    faults_env("ckpt_corrupt@step=2", state_dir=tmp_path / "state")
+    tr = _reg_trainer()
+    loader = _reg_loader()
+    loader.set_epoch(0)
+    it = iter(loader)
+    tr.train_step(next(it))
+    with CheckpointManager(tmp_path / "ck") as mgr:
+        mgr.save(1, tr.state, force=True)
+        tr.train_step(next(it))
+        mgr.save(2, tr.state, force=True)
+        mgr.wait()  # manifests flush; the injection corrupts step 2
+        v = mgr.verify_step(2)
+        assert not v.ok, v
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tr.state)
+        state, step = mgr.restore_verified(
+            abstract_state_like(abstract, tr.state_shardings))
+        assert step == 1 and int(state.step) == 1
+        assert (tmp_path / "ck" / "quarantine" / "2").is_dir()
+
+
+def test_trainer_meta_is_atomic_and_resume_tolerates_torn_meta(tmp_path):
+    """Satellite: the steps_per_epoch sidecar is written via temp +
+    os.replace (no .tmp residue, valid JSON), and a torn/missing sidecar
+    downgrades the geometry check to a warning instead of bricking
+    resume."""
+    loader = _reg_loader()
+    tr = _reg_trainer(checkpoint_dir=str(tmp_path / "ck"))
+    tr.fit(loader, 1)
+    step = tr.checkpoint.latest_step()
+    meta = tmp_path / "ck" / f"trainer_meta_{step}.json"
+    assert meta.exists()
+    assert json.loads(meta.read_text())["steps_per_epoch"] == len(loader)
+    assert not list((tmp_path / "ck").glob("*.tmp"))
+
+    # torn meta: truncated JSON must warn, not crash — and training
+    # continues to the same final step an uninterrupted run reaches
+    meta.write_text('{"steps_per_epo')
+    resumed = _reg_trainer(checkpoint_dir=str(tmp_path / "ck"))
+    resumed.fit(loader, 2, resume=True)
+    assert int(resumed.state.step) == 2 * len(loader)
+
+    # missing meta: same tolerance
+    tr2 = _reg_trainer(checkpoint_dir=str(tmp_path / "ck2"))
+    tr2.fit(loader, 1)
+    (tmp_path / "ck2"
+     / f"trainer_meta_{tr2.checkpoint.latest_step()}.json").unlink()
+    resumed2 = _reg_trainer(checkpoint_dir=str(tmp_path / "ck2"))
+    resumed2.fit(loader, 2, resume=True)
+    assert int(resumed2.state.step) == 2 * len(loader)
+
+
+def test_flaky_reader_retried_in_files(tmp_path, faults_env):
+    """Satellite: data/files.py reads ride faults/retry — two injected
+    transient failures are absorbed; a persistently failing read still
+    raises after the policy's attempts."""
+    from pytorchdistributed_tpu.data.files import MappedImageDataset
+
+    rng = np.random.default_rng(0)
+    np.save(tmp_path / "train_images.npy",
+            rng.integers(0, 255, (8, 4, 4, 3)).astype(np.uint8))
+    np.save(tmp_path / "train_labels.npy",
+            rng.integers(0, 10, (8,)).astype(np.int32))
+
+    faults_env("io_err@p=1,n=2")
+    ds = MappedImageDataset(tmp_path)  # 2 failures < 4 attempts: loads
+    assert len(ds) == 8
+    batch = ds[np.arange(4)]
+    assert batch["image"].shape == (4, 4, 4, 3)
+
+    faults_env("io_err@p=1,n=50")
+    with pytest.raises(OSError, match="injected io_err"):
+        MappedImageDataset(tmp_path)
+
+
+def test_loader_slow_io_injection(faults_env, monkeypatch):
+    """The DataLoader's per-batch hook: slow_io stretches batch assembly
+    (observed via a recording sleep), io_err crashes the fetch."""
+    slept = []
+    monkeypatch.setattr(finject.time, "sleep", slept.append)
+    faults_env("slow_io@p=1,ms=50")
+    loader = _reg_loader(size=16, batch=8)
+    assert len(list(iter(loader))) == 2
+    assert slept == [pytest.approx(0.05)] * 2
+
+    faults_env("io_err@p=1,n=1")
+    with pytest.raises(OSError, match="injected io_err"):
+        list(iter(loader))
+
+
+# ---------------------------------------------------------------------------
+# multi-process chaos through run.py (slow tier)
+
+
+_CHAOS_WORKER = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import optax
+from pytorchdistributed_tpu.data import DataLoader, SyntheticRegressionDataset
+from pytorchdistributed_tpu.models import MLP
+from pytorchdistributed_tpu.runtime.mesh import create_mesh
+from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+ds = SyntheticRegressionDataset(size=64, in_dim=8, out_dim=1, seed=0)
+loader = DataLoader(ds, batch_size=8, num_replicas=1, rank=0, seed=0)
+tr = Trainer(MLP(features=(16, 1)), optax.sgd(0.05), mse_loss,
+             mesh=create_mesh(),
+             checkpoint_dir=os.environ["PTD_TEST_CKPT"],
+             checkpoint_every_steps=2, log_every=1, watchdog=False)
+metrics = tr.fit(loader, max_epochs=int(os.environ.get("PTD_TEST_EPOCHS",
+                                                       "2")),
+                 resume=True)
+with open(os.environ["PTD_TEST_OUT"], "w") as f:
+    json.dump(metrics, f)
+"""
+
+
+def _run_agent(script, tmp_path, tag, *run_args, env_extra=None,
+               epochs="2", timeout=600):
+    out = tmp_path / f"{tag}.json"
+    env = dict(os.environ,
+               PTD_TEST_CKPT=str(tmp_path / f"ckpt_{tag}"),
+               PTD_TEST_OUT=str(out), PTD_TEST_EPOCHS=epochs)
+    env.pop("PTD_FAULTS", None)
+    env.pop("PTD_FAULTS_STATE", None)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorchdistributed_tpu.run",
+         "--nproc-per-node", "1", "--devices-per-proc", "1",
+         "--monitor-interval", "0.1", *run_args, str(script)],
+        cwd=REPO, timeout=timeout, capture_output=True, text=True, env=env)
+    return proc, out
+
+
+@pytest.fixture(scope="module")
+def chaos_script(tmp_path_factory):
+    script = tmp_path_factory.mktemp("chaos") / "worker.py"
+    script.write_text(textwrap.dedent(_CHAOS_WORKER.format(repo=REPO)))
+    return script
+
+
+@pytest.fixture(scope="module")
+def clean_chaos_loss(chaos_script, tmp_path_factory):
+    """Final loss of an UNINTERRUPTED 2-epoch run of the chaos worker —
+    the continuity baseline every recovery scenario must match."""
+    tmp = tmp_path_factory.mktemp("chaos_baseline")
+    proc, out = _run_agent(chaos_script, tmp, "clean")
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(out.read_text())["loss"]
+
+
+def test_chaos_crash_restart_resumes_loss_continuity(
+        chaos_script, tmp_path, clean_chaos_loss):
+    """Acceptance anchor: an injected single-rank crash mid-epoch,
+    relaunched by --max-restarts, resumes from checkpoint and lands on
+    the uninterrupted run's final loss exactly (same data order via
+    set_epoch + skip_steps, same per-step rng folded from state.step)."""
+    proc, out = _run_agent(chaos_script, tmp_path, "crashed",
+                           "--max-restarts", "1",
+                           "--faults", "crash@step=6,rank=0")
+    assert proc.returncode == 0, proc.stderr
+    assert "injected crash at step 6" in proc.stderr, proc.stderr
+    assert "restart 1/1" in proc.stderr, proc.stderr
+    assert "resumed from step" in proc.stdout, (proc.stdout, proc.stderr)
+    assert json.loads(out.read_text())["loss"] == pytest.approx(
+        clean_chaos_loss, rel=1e-6)
+
+
+def test_chaos_hang_heartbeat_relaunch(chaos_script, tmp_path,
+                                       clean_chaos_loss):
+    """An injected SIGSTOP hang is invisible to exit-watching; the
+    heartbeat watchdog must flag it, relaunch, and the resumed
+    incarnation must finish with the continuity loss."""
+    proc, out = _run_agent(
+        chaos_script, tmp_path, "hung",
+        "--max-restarts", "1", "--heartbeat-timeout", "3.0",
+        "--heartbeat-grace", "120.0",
+        "--faults", "hang@step=4,rank=0")
+    assert proc.returncode == 0, proc.stderr
+    assert "injected hang at step 4" in proc.stderr, proc.stderr
+    assert "hung (heartbeat stale)" in proc.stderr, proc.stderr
+    assert json.loads(out.read_text())["loss"] == pytest.approx(
+        clean_chaos_loss, rel=1e-6)
+
+
+def test_chaos_preemption_durable_verified_uncharged(
+        chaos_script, tmp_path, clean_chaos_loss):
+    """SIGTERM preemption contract end to end: the injected preemption
+    finishes its step, drains a DURABLE VERIFIED checkpoint, exits with
+    the distinct code; the agent restarts it as 'preempted' (never
+    attributed to the rank) and the resumed run matches the continuity
+    loss. The checkpoint directory passes the offline verify CLI."""
+    from pytorchdistributed_tpu.training import checkpoint as ckpt_mod
+
+    proc, out = _run_agent(chaos_script, tmp_path, "preempted",
+                           "--max-restarts", "1",
+                           "--faults", "preempt@step=3,rank=0")
+    assert proc.returncode == 0, proc.stderr
+    assert "injected preemption at step 3" in proc.stderr, proc.stderr
+    assert "preempted (graceful, checkpoint drained)" in proc.stderr, \
+        proc.stderr
+    assert "restart 1/1" in proc.stderr, proc.stderr
+    # step 3 was forced durable by the handler (not an interval step),
+    # resumed from, and the whole surviving directory verifies clean
+    assert "resumed from step 3" in proc.stdout, proc.stdout
+    assert ckpt_mod.main(["verify", str(tmp_path / "ckpt_preempted")]) == 0
+    assert json.loads(out.read_text())["loss"] == pytest.approx(
+        clean_chaos_loss, rel=1e-6)
+
+
+def test_chaos_corrupt_latest_fallback_resume(chaos_script, tmp_path,
+                                              clean_chaos_loss):
+    """The acceptance scenario through run.py: epoch 1's final checkpoint
+    is corrupted on disk between incarnations; the resumed run must
+    quarantine it, fall back to the previous verified step, retrain the
+    gap, and still land on the continuity loss."""
+    proc, _ = _run_agent(chaos_script, tmp_path, "fallback", epochs="1")
+    assert proc.returncode == 0, proc.stderr
+    ckpt = tmp_path / "ckpt_fallback"
+    latest = max(int(p.name) for p in ckpt.iterdir() if p.name.isdigit())
+    assert latest == 8
+    target = max((p for p in (ckpt / "8").rglob("*")
+                  if p.is_file() and "manifest" not in p.name.lower()),
+                 key=lambda p: p.stat().st_size)
+    data = bytearray(target.read_bytes())
+    for j in range(min(64, len(data))):
+        data[j] ^= 0xFF
+    target.write_bytes(bytes(data))
+
+    proc, out = _run_agent(chaos_script, tmp_path, "fallback")
+    assert proc.returncode == 0, proc.stderr
+    assert "fell back to step 6" in proc.stdout, (proc.stdout, proc.stderr)
+    assert (ckpt / "quarantine" / "8").is_dir()
+    assert json.loads(out.read_text())["loss"] == pytest.approx(
+        clean_chaos_loss, rel=1e-6)
+
+
+def test_chaos_repeated_crash_shrinks_but_preemption_never_does(tmp_path):
+    """The shrink-tracker attribution rule, same scenario twice: rank 2
+    failing twice in a row shrinks the group; rank 2 PREEMPTING twice in
+    a row must not — reclaimed capacity is not a bad slot. Synthetic
+    steppers (no jax) keep it fast."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, signal, sys, time
+        sys.path.insert(0, {REPO!r})
+        from pytorchdistributed_tpu.faults import (
+            EXIT_PREEMPTED, FaultInjector)
+        signal.signal(signal.SIGTERM,
+                      lambda s, f: sys.exit(EXIT_PREEMPTED))
+        inj = FaultInjector.from_env()
+        for s in range(1, 9):
+            if inj is not None:
+                inj.on_step(s)
+            time.sleep(0.05)
+    """))
+
+    def run(spec, max_restarts):
+        return subprocess.run(
+            [sys.executable, "-m", "pytorchdistributed_tpu.run",
+             "--nproc-per-node", "3", "--max-restarts", str(max_restarts),
+             "--elastic-min-nproc", "2", "--monitor-interval", "0.1",
+             "--faults", spec, str(script)],
+            cwd=REPO, timeout=180, capture_output=True, text=True,
+            env={k: v for k, v in os.environ.items()
+                 if k not in ("PTD_FAULTS", "PTD_FAULTS_STATE")})
+
+    # crashes: same rank twice in a row -> elastic shrink (uncharged)
+    proc = run("crash@step=2,rank=2; crash@step=3,rank=2", 1)
+    assert proc.returncode == 0, proc.stderr
+    assert "resizing group to 2 (elastic)" in proc.stderr, proc.stderr
+
+    # preemptions: same rank twice -> two charged restarts, NO shrink
+    proc = run("preempt@step=2,rank=2; preempt@step=3,rank=2", 2)
+    assert proc.returncode == 0, proc.stderr
+    assert "preempted (graceful" in proc.stderr, proc.stderr
+    assert "restart 2/2" in proc.stderr, proc.stderr
+    assert "resizing" not in proc.stderr, proc.stderr
+
+
+def test_agent_forwards_signals_to_workers(tmp_path):
+    """Satellite: SIGTERM to the AGENT reaches every worker (graceful
+    teardown, no orphans) and the agent reports the forwarding."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, signal, sys, time
+        tmp = {str(tmp_path)!r}
+        rank = os.environ["RANK"]
+        def bye(signum, frame):
+            open(os.path.join(tmp, "sigterm" + rank), "w").close()
+            sys.exit(0)
+        signal.signal(signal.SIGTERM, bye)
+        open(os.path.join(tmp, "started" + rank), "w").close()
+        for _ in range(600):
+            time.sleep(0.1)
+        sys.exit(3)  # never reached when forwarding works
+    """))
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "pytorchdistributed_tpu.run",
+         "--nproc-per-node", "2", "--monitor-interval", "0.1",
+         "--preempt-grace", "10.0", str(script)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not (
+                os.path.exists(tmp_path / "started0")
+                and os.path.exists(tmp_path / "started1")):
+            time.sleep(0.1)
+        assert os.path.exists(tmp_path / "started1"), "workers never started"
+        agent.send_signal(signal.SIGTERM)
+        stdout, stderr = agent.communicate(timeout=60)
+    finally:
+        if agent.poll() is None:
+            agent.kill()
+    assert agent.returncode == 0, stderr  # workers drained with exit 0
+    assert "forwarding to workers" in stderr, stderr
+    assert os.path.exists(tmp_path / "sigterm0"), stderr
+    assert os.path.exists(tmp_path / "sigterm1"), stderr

@@ -301,3 +301,52 @@ def test_quantized_engine_matches_quantized_generate():
     cfg = gpt2_config("test", num_layers=2, max_seq_len=64,
                       quant="int8_fwd")
     _assert_parity(GPT2, cfg, num_slots=2, n_requests=3)
+
+
+def test_deadline_expires_without_disturbing_other_slots(tmp_path):
+    """ISSUE 4 satellite: per-request deadline_s. A request dead on the
+    queue is shed before wasting a prefill; one expiring mid-decode is
+    retired with the distinct "deadline" finish reason, both leave
+    telemetry rows, and every OTHER slot keeps serving bitwise-correct
+    tokens throughout."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    engine = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                           telemetry_dir=tmp_path)
+    engine.warmup(prompt_lens=(8,))
+    rng = np.random.default_rng(3)
+    pa = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    pb = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    pc = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    a = engine.submit(pa, max_new_tokens=6)
+    b = engine.submit(pb, max_new_tokens=40, deadline_s=60.0)
+    c = engine.submit(pc, max_new_tokens=4, deadline_s=0.0)  # dead on queue
+    stats = engine.step()  # c shed pre-admission; a + b admitted
+    assert stats["expired"] == 1
+    assert c.done and c.finish_reason == "deadline" and not c.new_tokens
+    assert c.slot is None  # never admitted, no prefill paid
+    assert b.slot is not None and len(b.new_tokens) >= 1
+    # lapse b's budget deterministically (no wall-clock sleep, no flake
+    # under CI load): rewind its submission clock past the deadline
+    b.submit_time -= 120.0
+    engine.run_until_idle()
+    assert b.done and b.finish_reason == "deadline"
+    assert 0 < len(b.new_tokens) < 40  # delivered tokens stay delivered
+    # the co-resident request was never disturbed: full budget, greedy
+    # tokens bitwise-equal to generate()
+    assert a.done and a.finish_reason == "length" and len(a.new_tokens) == 6
+    ref = generate(dm, params, jnp.asarray(pa)[None], max_new_tokens=6)
+    np.testing.assert_array_equal(a.output_ids, np.asarray(ref)[0])
+    assert engine.summary()["deadline_expired"] == 2
+    # the engine keeps admitting after expiries (slots were freed)
+    d = engine.submit(pa, max_new_tokens=3)
+    engine.run_until_idle()
+    assert d.done and d.finish_reason == "length"
+    engine.close()
+    rows = [json.loads(x) for x in
+            (tmp_path / "serve_metrics_rank0.jsonl")
+            .read_text().strip().splitlines()]
+    reasons = [r["finish_reason"] for r in rows if r["kind"] == "request"]
+    assert reasons.count("deadline") == 2, reasons
