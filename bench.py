@@ -135,6 +135,14 @@ def _accounting_fields(trainer, batch, result: dict, sec: float) -> dict:
         print(f"bench: step accounting skipped ({e})", file=sys.stderr)
         return result
     result["comm_bytes_per_step"] = acct.comm_bytes_per_step
+    # estimated comm-stall fraction of the measured step (ISSUE 5c):
+    # per-device collective bytes at nominal ICI bandwidth over the real
+    # step time — the zero-overlap upper bound; read next to the overlap
+    # mode stamped by the bench and the HLO overlap census
+    stall = acct.comm_stall_frac(sec)
+    if stall is not None:
+        result["comm_stall_frac"] = stall
+        result["comm_stall_ici"] = acct.ici_source
     if "mfu" not in result:
         mfu = acct.mfu(sec)
         if mfu is not None:
@@ -219,6 +227,27 @@ def _quant_override(default: str = "none") -> str:
     return val
 
 
+def _overlap_override(default: str = "xla") -> str:
+    """PTD_OVERLAP={ring,xla,off} flips the LM benches' collective-overlap
+    mode (TransformerConfig.overlap + the Trainer's latency-hiding
+    scheduler flags) for chip A/Bs without code edits. Unset takes the
+    committed default ("xla" — the monolithic collectives the committed
+    baselines were measured with ride the same compiled program; "off"
+    additionally drops the scheduler flags, giving the no-overlap
+    baseline the acceptance criterion compares against)."""
+    import os
+
+    from pytorchdistributed_tpu.parallel.overlap import OVERLAP_MODES
+
+    val = os.environ.get("PTD_OVERLAP")
+    if val is None:
+        return default
+    if val not in OVERLAP_MODES:
+        raise SystemExit(f"bench: PTD_OVERLAP={val!r} must be one of "
+                         f"{'|'.join(OVERLAP_MODES)}")
+    return val
+
+
 def _stamp_overrides(result: dict,
                      keys: tuple = ("PTD_FUSED_NORMS",)) -> dict:
     """Stamp the A/B env knobs THIS bench actually reads into the record:
@@ -256,12 +285,13 @@ def bench_gpt2(size: str = "small") -> dict:
     # remat="dots" is the fallback for bigger models/batches (config.py).
     import os
     attn_block = os.environ.get("PTD_ATTN_BLOCK")
+    overlap = _overlap_override()
     cfg = gpt2_config(size, attention=attention, remat=False,
                       scan_layers=False,
                       ce_chunk=int(os.environ.get("PTD_CE_CHUNK", 2048)),
                       attn_block=int(attn_block) if attn_block else None,
                       fused_norms=_fused_norms_override(),
-                      quant=_quant_override())
+                      quant=_quant_override(), overlap=overlap)
     model = GPT2(cfg)
     # r2 measured dense CE faster than the fused chunked head for SMALL at
     # batch 8 (BASELINE.md r2-late note); PTD_FUSED_CE=1 re-opens the A/B
@@ -273,7 +303,8 @@ def bench_gpt2(size: str = "small") -> dict:
     else:
         loss_fn = token_cross_entropy_loss
     trainer = Trainer(model, optax.adamw(3e-4), loss_fn,
-                      mesh=create_mesh(), strategy="dp", log_every=10**9)
+                      mesh=create_mesh(), strategy="dp", log_every=10**9,
+                      overlap=overlap)
     rng = np.random.default_rng(0)
     batch = {
         "tokens": rng.integers(0, 50257, (batch_size, seq_len)).astype(
@@ -285,11 +316,12 @@ def bench_gpt2(size: str = "small") -> dict:
     tokens = batch_size * seq_len
     tag = {"small": "gpt2s", "medium": "gpt2m"}.get(size, f"gpt2_{size}")
     result = {"metric": f"{tag}_train_tokens_per_s",
-              "value": round(tokens / sec, 1), "unit": "tokens/s"}
+              "value": round(tokens / sec, 1), "unit": "tokens/s",
+              "overlap": overlap}
     # PTD_CE_CHUNK only does anything here under the fused head — stamping
     # it on the dense-CE path would taint a committed-config record
     keys = ("PTD_FUSED_CE", "PTD_ATTN_BLOCK", "PTD_FUSED_NORMS",
-            "PTD_QUANT")
+            "PTD_QUANT", "PTD_OVERLAP")
     if os.environ.get("PTD_FUSED_CE") == "1":
         keys += ("PTD_CE_CHUNK",)
     _stamp_overrides(result, keys)
@@ -331,14 +363,15 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     batch_size = int(os.environ.get("PTD_BENCH_BS", batch_size))
     remat_policy = os.environ.get("PTD_REMAT_POLICY", "dots_all")
     ce_chunk = int(os.environ.get("PTD_CE_CHUNK", 2048))
+    overlap = _overlap_override()
     cfg = llama_config("1b", max_seq_len=seq_len, attention=attention,
                        remat=True, remat_policy=remat_policy,
                        scan_layers=False, ce_chunk=ce_chunk,
                        fused_norms=_fused_norms_override(),
-                       quant=_quant_override())
+                       quant=_quant_override(), overlap=overlap)
     trainer = Trainer(Llama(cfg), optax.adafactor(3e-3),
                       fused_token_cross_entropy_loss, mesh=create_mesh(),
-                      strategy="dp", log_every=10**9)
+                      strategy="dp", log_every=10**9, overlap=overlap)
     rng = np.random.default_rng(0)
     batch = {
         "tokens": rng.integers(0, 32000, (batch_size, seq_len)).astype(
@@ -349,10 +382,11 @@ def bench_llama1b(batch_size: int = 8, seq_len: int = 1024,
     sec = _time_steps(trainer, batch, steps=10)
     tokens = batch_size * seq_len
     result = {"metric": metric,
-              "value": round(tokens / sec, 1), "unit": "tokens/s"}
+              "value": round(tokens / sec, 1), "unit": "tokens/s",
+              "overlap": overlap}
     _stamp_overrides(result, ("PTD_BENCH_BS", "PTD_REMAT_POLICY",
                               "PTD_CE_CHUNK", "PTD_FUSED_NORMS",
-                              "PTD_QUANT"))
+                              "PTD_QUANT", "PTD_OVERLAP"))
     mfu = _mfu(transformer_train_flops_per_token(cfg) * tokens, sec)
     if mfu is not None:
         result["mfu"] = mfu
@@ -385,13 +419,14 @@ def bench_bert(size: str = "base", batch_size: int = 64,
     # fused_norms=True is BERT's committed-fastest config (the one family
     # where the r5 A/B favored the custom_vjp backward; see
     # _fused_norms_override)
+    overlap = _overlap_override()
     cfg = bert_config(size, max_seq_len=seq_len, attention=attention,
                       remat=False, scan_layers=False,
                       fused_norms=_fused_norms_override(default=True),
-                      quant=_quant_override())
+                      quant=_quant_override(), overlap=overlap)
     trainer = Trainer(BertMLM(cfg), optax.adamw(1e-4),
                       token_cross_entropy_loss, mesh=create_mesh(),
-                      strategy="dp", log_every=10**9)
+                      strategy="dp", log_every=10**9, overlap=overlap)
     ds = MLMDataset(
         SyntheticTokenDataset(size=batch_size, seq_len=seq_len,
                               vocab_size=cfg.vocab_size, seed=0),
@@ -402,8 +437,10 @@ def bench_bert(size: str = "base", batch_size: int = 64,
         size, f"bert_{size}")
     result = {"metric": f"{tag}_mlm_samples_per_s",
               "value": round(batch_size / sec, 1), "unit": "samples/s",
-              "tokens_per_s": round(batch_size * seq_len / sec, 1)}
-    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT"))
+              "tokens_per_s": round(batch_size * seq_len / sec, 1),
+              "overlap": overlap}
+    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT",
+                              "PTD_OVERLAP"))
     mfu = _mfu(transformer_train_flops_per_token(cfg)
                * batch_size * seq_len, sec)
     if mfu is not None:
@@ -426,12 +463,14 @@ def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
     from pytorchdistributed_tpu.runtime.mesh import create_mesh
     from pytorchdistributed_tpu.training import Trainer, cross_entropy_loss
 
+    overlap = _overlap_override()
     cfg = vit_config(size, attention="dense", remat=False,
                      scan_layers=False,
                      fused_norms=_fused_norms_override(),
-                     quant=_quant_override())
+                     quant=_quant_override(), overlap=overlap)
     trainer = Trainer(ViT(cfg), optax.adamw(3e-4), cross_entropy_loss,
-                      mesh=create_mesh(), strategy="dp", log_every=10**9)
+                      mesh=create_mesh(), strategy="dp", log_every=10**9,
+                      overlap=overlap)
     rng = np.random.default_rng(0)
     batch = {
         "image": rng.standard_normal(
@@ -444,8 +483,10 @@ def bench_vit(size: str = "large", batch_size: int = 64) -> dict:
     seq = cfg.num_patches + 1
     tag = {"large": "vit_l16"}.get(size, f"vit_{size}_p16")
     result = {"metric": f"{tag}_train_img_per_s",
-              "value": round(batch_size / sec, 1), "unit": "img/s"}
-    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT"))
+              "value": round(batch_size / sec, 1), "unit": "img/s",
+              "overlap": overlap}
+    _stamp_overrides(result, ("PTD_FUSED_NORMS", "PTD_QUANT",
+                              "PTD_OVERLAP"))
     mfu = _mfu(transformer_train_flops_per_token(cfg.transformer)
                * batch_size * seq, sec)
     if mfu is not None:

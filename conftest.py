@@ -94,6 +94,25 @@ _QUICK = (
     # (CPU compiles are ~30-100 s each cold)
     "test_compiled_invariants.py::test_structural_invariants",
     "test_compiled_invariants.py::test_analytic_flops_formula_pinned",
+    # latency-hiding collectives (ISSUE 5): ring-primitive numerics +
+    # routing/fallback units, the fp32 and int8 tp parity anchors, the
+    # zero-recompile tripwire, the census parser unit and the satellite
+    # units (ring_schedule / all_to_all validation / prefetch depth +
+    # Trainer knobs) plus the structural comm_stall_frac pins; the bf16
+    # parity trio and the census-decomposition test stay full-suite-only
+    # (each builds multiple trainers) — quick-tier ring-census coverage
+    # is the committed tp4_dp2_ring* pins in test_structural_invariants
+    "test_overlap.py::TestRingPrimitives",
+    "test_overlap.py::TestRouting",
+    "test_overlap.py::test_parity_tp_fp32_exact",
+    "test_overlap.py::test_parity_tp_int8",
+    "test_overlap.py::test_zero_steadystate_recompiles",
+    "test_overlap.py::test_overlap_census_parses_async_pairs",
+    "test_overlap.py::test_ring_schedule",
+    "test_overlap.py::test_all_to_all_validates_axes",
+    "test_overlap.py::test_prefetch_depth_zero_is_synchronous",
+    "test_overlap.py::test_trainer_prefetch_knob",
+    "test_compiled_invariants.py::test_comm_stall_frac_pinned",
     # serving engine (ISSUE 3): the HLO pins for the tick/prefill pair
     # (+--quant variants), the greedy-parity-vs-generate() anchor, the
     # zero-recompile steady-state guarantee, and the generate() bucketing

@@ -57,6 +57,11 @@ class ExperimentConfig:
     # Applies to the transformer families' QKV/out/MLP/LM-head (and
     # fused-CE) contractions plus the MLP toy; implies bf16 compute.
     quant: str = "none"            # none | int8_fwd | int8
+    # Collective-latency hiding (ops/overlap.py + trainer scheduler
+    # flags): "xla" = monolithic collectives + XLA latency-hiding
+    # scheduler (default), "ring" = decomposed collective-matmul rings on
+    # the TP projections too, "off" = neither (the measured baseline).
+    overlap: str = "xla"           # ring | xla | off
     # training
     max_epochs: int = 1
     batch_size: int = 32           # per-process
@@ -210,8 +215,11 @@ def _build_model(cfg: ExperimentConfig):
     # rescales through fp32 either way; fp32 "compute" would only slow
     # the non-matmul remainder)
     dtype = jnp.bfloat16 if (cfg.bf16 or cfg.quant != "none") else jnp.float32
+    from pytorchdistributed_tpu.parallel.overlap import validate_overlap
+
+    validate_overlap(cfg.overlap)
     tkw = dict(attention=cfg.attention, remat=cfg.remat, dtype=dtype,
-               quant=cfg.quant,
+               quant=cfg.quant, overlap=cfg.overlap,
                fused_norms=cfg.fused_norms,
                pipeline_stages=cfg.pipe if cfg.pipe > 1 else 1,
                pipeline_microbatches=cfg.pipeline_microbatches,
@@ -436,5 +444,6 @@ def make_trainer(cfg: ExperimentConfig):
         profile_dir=cfg.profile_dir or None,
         metrics_file=cfg.metrics_file or None,
         accum_steps=cfg.accum_steps,
+        overlap=cfg.overlap,
     )
     return trainer, loader

@@ -101,14 +101,24 @@ def prefetch_to_device(
     transfer of batch k+1 overlaps the compute of batch k. ``tracer`` (a
     telemetry.SpanTracer) records each shard/H2D handoff as an
     "h2d_transfer" host span — note the span covers the *dispatch* of the
-    transfer; the DMA itself overlaps compute by design."""
+    transfer; the DMA itself overlaps compute by design.
+
+    ``size`` is the configurable depth (Trainer(prefetch=N) /
+    PTD_PREFETCH): 2 is the committed double-buffer default; deeper
+    queues buy jitter tolerance at ``size`` batches of extra device
+    memory; ``size=0`` degrades to fully synchronous transfer — each
+    batch is sharded and handed over immediately, nothing queued ahead
+    (the debugging/memory-floor mode, and the semantics every positive
+    depth reduces to at iterator exhaustion)."""
+    if size < 0:
+        raise ValueError(f"prefetch size must be >= 0, got {size}")
     queue: collections.deque = collections.deque()
     for batch in iterator:
         cm = (tracer.span("h2d_transfer") if tracer is not None
               else contextlib.nullcontext())
         with cm:
             queue.append(shard_batch(batch, sharding))
-        if len(queue) >= size:
+        if len(queue) >= size:  # size 0: always — fully synchronous
             yield queue.popleft()
     while queue:
         yield queue.popleft()

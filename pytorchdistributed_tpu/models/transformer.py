@@ -93,6 +93,20 @@ class TransformerConfig:
     # dot_general is plain HLO, so TP's column/row splits, FSDP gathers and
     # the pipeline stage axis apply to the int8 operands unmodified.
     quant: str = "none"                 # none | int8_fwd | int8
+    # Collective-latency hiding for the TP hot path (ops/overlap.py +
+    # parallel/overlap.py — ISSUE 5). "xla": monolithic collectives, XLA's
+    # latency-hiding scheduler does the overlap (the Trainer wires the
+    # scheduler flags); "ring": route the QKV/out/MLP projections through
+    # hand-decomposed collective-matmul rings (all-gather→matmul and
+    # matmul→reduce-scatter as ppermute chains interleaved with the
+    # chunks) whenever the ambient mesh has a tensor axis > 1 — the
+    # ASPLOS'23 decomposition, wins at small tp axes / ICI-bound shapes;
+    # "off": monolithic collectives AND no scheduler flags (the measured
+    # baseline). Composes with quant: the ring gathers int8 shards
+    # (comm bytes ÷4). Decode and pipeline stage bodies always take the
+    # monolithic path (s=1 can't ring; stages already run inside a
+    # manual region).
+    overlap: str = "xla"                # ring | xla | off
     activation: str = "gelu"            # gelu | swiglu
     rope: bool = False                  # rotary position embedding (no
     #                                     learned pos table when True)
@@ -158,6 +172,9 @@ class TransformerConfig:
         if self.quant not in ("none", "int8_fwd", "int8"):
             raise ValueError(f"unknown quant {self.quant!r}; "
                              f"one of ('none', 'int8_fwd', 'int8')")
+        from pytorchdistributed_tpu.parallel.overlap import validate_overlap
+
+        validate_overlap(self.overlap)
         kv = self.kv_heads
         if kv <= 0 or self.num_heads % kv:
             raise ValueError(
@@ -270,18 +287,35 @@ def _cfg_dot_general(cfg, default=None):
     return dot_general_for(cfg.quant) or default
 
 
+def _site_dot_general(cfg, parallel, default=None):
+    """Per-site contraction for the TP projections: with
+    ``cfg.overlap == "ring"`` and a parallel kind declared, the
+    ring-routing injectable (parallel/overlap.py — falls back to the
+    monolithic/quant path at trace time when no ring applies); otherwise
+    exactly `_cfg_dot_general`. ``parallel`` is "column" (w's feature dim
+    tensor-sharded) or "row" (contraction dim tensor-sharded), per the
+    Megatron decomposition the kernel's logical axes already declare."""
+    if parallel is None:
+        return _cfg_dot_general(cfg, default)
+    from pytorchdistributed_tpu.parallel.overlap import site_dot_general
+
+    return site_dot_general(cfg, parallel, default)
+
+
 def _dense_general(features: int, kernel_axes, cfg, name, *,
-                   use_bias: bool = True):
+                   use_bias: bool = True, parallel: str | None = None):
     """Dense with logically-partitioned kernel. Head projections keep heads
     flattened into the feature dim (kernel [embed, heads*head_dim] with
     logical axes (embed, heads)): sharding "heads" over the tensor axis then
-    splits whole heads, the Megatron attention shard."""
+    splits whole heads, the Megatron attention shard. ``parallel`` names
+    the site's Megatron role so overlap="ring" can route it through the
+    matching collective-matmul ring."""
     return nn.Dense(
         features,
         use_bias=use_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        dot_general=_cfg_dot_general(cfg),
+        dot_general=_site_dot_general(cfg, parallel),
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(stddev=0.02), kernel_axes
         ),
@@ -338,8 +372,8 @@ class SelfAttention(nn.Module):
             )
             eq = "bse,ecf->bscf" if stack > 1 else "bse,ef->bsf"
             out = jnp.einsum(eq, x, kernel.astype(cfg.dtype),
-                             _dot_general=_cfg_dot_general(
-                                 cfg, jax.lax.dot_general))
+                             _dot_general=_site_dot_general(
+                                 cfg, "column", jax.lax.dot_general))
             if cfg.use_bias:
                 bias = self.param(
                     f"{name}_bias",
@@ -465,7 +499,7 @@ class SelfAttention(nn.Module):
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         out = _dense_general(
             cfg.embed_dim, (Logical.HEADS, Logical.EMBED), cfg, "out",
-            use_bias=cfg.use_bias,
+            use_bias=cfg.use_bias, parallel="row",
         )(out)
         if cfg.dropout_rate > 0:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
@@ -497,8 +531,8 @@ class MlpBlock(nn.Module):
                 cfg.param_dtype,
             )
             gu = jnp.einsum("bse,ecf->bscf", x, kernel.astype(cfg.dtype),
-                            _dot_general=_cfg_dot_general(
-                                cfg, jax.lax.dot_general))
+                            _dot_general=_site_dot_general(
+                                cfg, "column", jax.lax.dot_general))
             if cfg.use_bias:
                 bias = self.param(
                     "wi_bias",
@@ -511,12 +545,14 @@ class MlpBlock(nn.Module):
             h = nn.silu(gu[..., 0, :]) * gu[..., 1, :]
         else:
             h = _dense_general(cfg.ffn_dim, (Logical.EMBED, Logical.MLP), cfg,
-                               "wi", use_bias=cfg.use_bias)(x)
+                               "wi", use_bias=cfg.use_bias,
+                               parallel="column")(x)
             h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.with_logical_constraint(
             h, (Logical.BATCH, Logical.SEQ, Logical.MLP))
         out = _dense_general(cfg.embed_dim, (Logical.MLP, Logical.EMBED), cfg,
-                             "wo", use_bias=cfg.use_bias)(h)
+                             "wo", use_bias=cfg.use_bias,
+                             parallel="row")(h)
         if cfg.dropout_rate > 0:
             out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
         return out

@@ -1,7 +1,3 @@
-from pytorchdistributed_tpu.ops.quant import (  # noqa: F401
-    dot_general_for,
-    quantized_dot_general,
-)
 from pytorchdistributed_tpu.ops.collectives import (  # noqa: F401
     all_gather,
     all_reduce_mean,
@@ -10,4 +6,13 @@ from pytorchdistributed_tpu.ops.collectives import (  # noqa: F401
     broadcast_from,
     ppermute_ring,
     reduce_scatter,
+    ring_schedule,
+)
+from pytorchdistributed_tpu.ops.overlap import (  # noqa: F401
+    ring_column_matmul,
+    ring_row_matmul,
+)
+from pytorchdistributed_tpu.ops.quant import (  # noqa: F401
+    dot_general_for,
+    quantized_dot_general,
 )

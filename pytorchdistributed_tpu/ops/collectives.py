@@ -7,7 +7,12 @@ XLA HLO ops executed by the runtime over the ICI torus (intra-slice) or DCN
 (cross-slice), already implemented as the hardware-optimal ring/torus
 algorithms. These wrappers exist so schedules and tests can name the
 operation they mean; inside `jit` + sharding, XLA usually inserts them
-automatically, which is the TPU answer to DDP's bucketed Reducer.
+automatically, which is the TPU answer to DDP's bucketed Reducer — a
+claim that is now FORCED and MEASURED rather than assumed: the Trainer
+wires XLA's latency-hiding scheduler flags, ops/overlap.py decomposes
+the TP matmul collectives into ppermute rings, and
+utils/hlo.overlap_census counts the async start/done pairs and the ops
+scheduled inside them (ISSUE 5).
 
 All functions must run inside `shard_map`/`pmap`-style contexts where the
 named axis is bound.
@@ -50,18 +55,46 @@ def broadcast_from(x, axis_name: str, *, root: int = 0):
     return lax.psum(masked, axis_name) if size > 1 else x
 
 
+def ring_schedule(axis_size: int, shift: int = 1) -> list[tuple[int, int]]:
+    """The (source, destination) permutation of a ring rotation: member i
+    sends to i+shift (mod n), i.e. everyone *receives* from i-shift. One
+    definition shared by `ppermute_ring`, ring attention's K/V rotation
+    and the decomposed collective matmuls (ops/overlap.py), so every ring
+    in the codebase agrees on hop direction — a ring whose send direction
+    silently disagreed with the index arithmetic `(my - step) % n` would
+    compute with the wrong shard and no shape error would catch it."""
+    if axis_size < 1:
+        raise ValueError(f"ring_schedule needs axis_size >= 1, "
+                         f"got {axis_size}")
+    if shift % axis_size == 0:
+        # a zero-shift "rotation" is the identity; emitting it as a
+        # ppermute would still pay a collective for a no-op
+        return [(i, i) for i in range(axis_size)]
+    return [(i, (i + shift) % axis_size) for i in range(axis_size)]
+
+
 def ppermute_ring(x, axis_name: str, *, shift: int = 1):
     """Rotate shards around the ring: member i receives from i-shift.
-    The building block of ring attention (SURVEY.md §5) and pipelined
-    stage-boundary transfer."""
+    The building block of ring attention (SURVEY.md §5), the decomposed
+    collective matmuls (ops/overlap.py) and pipelined stage-boundary
+    transfer."""
     n = lax.axis_size(axis_name)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, perm)
+    return lax.ppermute(x, axis_name, ring_schedule(n, shift))
 
 
 def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):
     """NCCL alltoall: re-shard which dimension is split across the axis
-    (Ulysses-style head↔sequence redistribution)."""
+    (Ulysses-style head↔sequence redistribution). Axis bounds are
+    validated here: an out-of-range split/concat axis otherwise surfaces
+    as an XLA lowering crash deep inside the partitioner, with no hint of
+    which call site passed the bad dimension."""
+    ndim = jnp.ndim(x)
+    for name, ax in (("split_axis", split_axis),
+                     ("concat_axis", concat_axis)):
+        if not isinstance(ax, int) or not 0 <= ax < ndim:
+            raise ValueError(
+                f"all_to_all {name}={ax!r} out of range for a rank-{ndim} "
+                f"operand (valid axes: 0..{ndim - 1})")
     return lax.all_to_all(
         x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
         tiled=True,

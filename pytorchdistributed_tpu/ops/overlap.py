@@ -1,0 +1,382 @@
+"""Latency-hiding decomposed collective matmuls (ISSUE 5 tentpole).
+
+The reference's headline DDP mechanism is *overlap*: the bucketed Reducer
+starts gradient all-reduce while backward still runs (reference
+ddp_gpus.py:35, 02_ddp.ipynb:33-47). On TPU the analog for TP matmuls is
+the hand-decomposed **collective matmul** (Wang et al., "Overlapping
+Communication with Dependent Computation via Decomposition", ASPLOS'23):
+instead of one monolithic all-gather/reduce-scatter that serializes
+against the MXU, the collective is unrolled into a ring of `ppermute`
+hops interleaved with the matmul chunks that consume/produce each shard —
+XLA's scheduler can then issue hop i+1's DMA while chunk i multiplies,
+hiding the ICI latency entirely at ICI-bound shapes.
+
+Two primitives, transposes of each other:
+
+  * ``ring_column_matmul(x, w)`` — the **all-gather→matmul** ring for a
+    column-parallel projection (w's trailing feature dim sharded over the
+    ring axis). x enters the manual region *seq-split* over the ring axis
+    (a free slice — it was replicated there), and each of the n steps
+    multiplies the seq-chunk currently held while `ppermute`-ing it to
+    the neighbor; after n-1 hops every device has computed the full-seq
+    output for its feature shard. Same per-device FLOPs as the monolithic
+    matmul; the gather traffic rides the hops, hidden behind the chunks.
+  * ``ring_row_matmul(x, w)`` — the **matmul→reduce-scatter** ring for a
+    row-parallel projection (x's feature dim and w's contraction dim
+    sharded over the ring axis). Each step computes the partial product
+    for one seq-chunk and folds it into an accumulator that travels the
+    ring; after n-1 hops each device holds its seq-chunk fully reduced.
+    This is exactly the reduce-scatter half of the Megatron `g`
+    all-reduce, decomposed; the all-gather half is left to the SPMD
+    partitioner at the region boundary (where the scheduler-flag wiring,
+    trainer._default_compiler_options, makes it async).
+
+The backward pairs each ring with its transpose — d(ag-matmul)/dx is a
+matmul→reduce-scatter ring, d(mm-rs)/dx is an all-gather→matmul ring, and
+both dw's are a third ring (`_dw_ring_shard`) that rotates the seq-split
+operand against the resident one — so the backward hides its collectives
+the same way forward does. Like ops/ring_attention.py (the structure
+this module deliberately mirrors), the ``custom_vjp`` lives INSIDE the
+full-manual `jax.shard_map` region: flax's lifted scan leaks tracers on
+this jax vintage when a custom_vjp *wraps* a shard_map, and inside the
+region the replicated weight's gradient sum over the batch/seq axes is
+handled by shard_map's own transpose (it psums input cotangents over
+the axes an in_spec leaves unmentioned — the early-issued gradient
+reduce of the ISSUE's part (b)).
+
+Numerics: every chunk contracts with fp32 accumulation
+(``preferred_element_type``) and the result is cast once, so the ring is
+allclose (1e-5 fp32 / bf16-tolerance) to the monolithic matmul — the
+seq-chunking never splits a contraction in the column/dw rings, and the
+row ring's fp32 traveling accumulator is at least as accurate as the
+bf16 partial-sum all-reduce it replaces (tests/test_overlap.py pins
+this).
+
+Int8 composition (ops/quant.py): with ``quant`` set, the column ring
+pre-quantizes its traveling operand ONCE (per-row absmax scales over the
+contraction dim — identical scales to the monolithic quantized dot,
+since the gathered dim is not contracted) and ships the **int8 payload +
+fp32 row scales** around the ring — gather traffic ÷4 vs fp32 (÷2 vs
+bf16) on top of the overlap. The row/dw rings quantize their resident
+operands per chunk via quant's `_int8_dot_value` (their traveling tensor
+is a partial-sum accumulator / the already-shipped payload, so nothing
+extra moves). ``quant="int8"`` additionally stochastic-rounds the
+gradient operand in the backward rings, mirroring the monolithic mode's
+semantics (scales there are per-shard rather than cross-shard —
+documented, covered by the parity tolerance, not bit-equality);
+``int8_fwd`` keeps the backward rings full-precision on the saved
+operands, exactly like the monolithic custom_vjp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorchdistributed_tpu.ops.collectives import ring_schedule
+from pytorchdistributed_tpu.ops.quant import (
+    _int8_dot_value,
+    absmax_scale,
+    quantize,
+    stochastic_quantize,
+)
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+# batch leaves split over the data axes inside the manual region, the
+# same layout batch_leaf_sharding gives them outside it
+_BATCH = (Axis.DATA, Axis.FSDP)
+
+
+class _OverlapSpec(NamedTuple):
+    """Static ring configuration, threaded through custom_vjp as a
+    nondiff arg."""
+
+    axis_name: str              # the ring axis (normally "tensor")
+    quant: str | None           # None | "int8_fwd" | "int8"
+
+
+def _bwd_quant(spec: _OverlapSpec) -> _OverlapSpec:
+    """The backward rings' spec: quantized only in full "int8" mode —
+    "int8_fwd" keeps its backward in full precision on the saved
+    operands, the same contract as quant._quant_dot_bwd."""
+    return spec if spec.quant == "int8" else spec._replace(quant=None)
+
+
+# ---------------------------------------------------------------------------
+# per-shard ring passes (run inside shard_map, every mesh axis manual)
+# ---------------------------------------------------------------------------
+
+
+def _chunk_dot(a, b, dims, *, quant, sr_lhs=False, sr_rhs=False):
+    """One ring chunk's contraction, fp32 result: plain dot with fp32
+    accumulation, or the quantized dot (per-chunk dynamic scales — for
+    seq-chunked operands these equal the monolithic scales, the
+    contraction dim is never chunked)."""
+    if quant:
+        return _int8_dot_value(a, b, dims, sr_lhs=sr_lhs, sr_rhs=sr_rhs)
+    return lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _ag_matmul_shard(x, w, spec: _OverlapSpec, *, sr_ring=False):
+    """All-gather→matmul ring. x [b, s_l, e] is this device's seq chunk;
+    w [e, *f_local] the local feature shard (rank 2 or 3 — the fused QKV
+    / SwiGLU kernels carry a stack dim). Returns the full-seq output for
+    the local feature shard, [b, s_l·n, *f_local], fp32.
+
+    With quant set, the traveling payload is quantized ONCE up front
+    (per-row scales over e — the dim the ring never splits) and the hops
+    carry int8 values + fp32 scales: comm bytes ÷4 vs fp32."""
+    axis = spec.axis_name
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, s_l, _ = x.shape
+    dims = (((2,), (0,)), ((), ()))
+    perm = ring_schedule(n, 1)  # receive from my-1: hop i holds (my-i)%n
+    out = jnp.zeros((b, s_l * n) + w.shape[1:], jnp.float32)
+
+    if spec.quant:
+        sx = absmax_scale(x, (2,))                  # [b, s_l, 1]
+        qx = (stochastic_quantize if sr_ring else quantize)(x, sx)
+        sw = absmax_scale(w, (0,))                  # [1, *f_local]
+        qw = quantize(w, sw)
+        sw_out = jnp.squeeze(sw, axis=0)            # broadcast over (b, s)
+
+        def chunk(blk):
+            q_blk, s_blk = blk
+            y = lax.dot_general(q_blk, qw, dims,
+                                preferred_element_type=jnp.int32)
+            s_row = s_blk.reshape(s_blk.shape[:2] + (1,) * (w.ndim - 1))
+            return y.astype(jnp.float32) * s_row * sw_out
+
+        blk = (qx, sx)  # the int8 payload + its row scales travel
+    else:
+        def chunk(blk):
+            return lax.dot_general(blk, w, dims,
+                                   preferred_element_type=jnp.float32)
+
+        blk = x
+
+    for i in range(n):
+        src = (my - i) % n
+        y = chunk(blk)
+        start = (0, src * s_l) + (0,) * (w.ndim - 1)
+        out = lax.dynamic_update_slice(out, y, start)
+        if i != n - 1:
+            # the hop the scheduler hides behind the NEXT chunk's matmul
+            blk = jax.tree.map(lambda t: lax.ppermute(t, axis, perm), blk)
+    return out
+
+
+def _matmul_rs_shard(y, w, y_dims, w_dims, spec: _OverlapSpec, *,
+                     sr_lhs=False):
+    """Matmul→reduce-scatter ring. y [b, S_l, *k_local] holds the full
+    (ring-wise) seq extent with its trailing dims being this device's
+    contraction shard; w carries the matching local shard. Contracts
+    ``y_dims``×``w_dims`` per seq-chunk and ring-reduces the partials:
+    after the last hop each device holds its own seq chunk fully summed
+    over the ring axis — the reduce-scatter, decomposed. Returns
+    [b, S_l/n, *w_free] fp32."""
+    axis = spec.axis_name
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    s_l = y.shape[1] // n
+    dims = ((y_dims, w_dims), ((), ()))
+    perm = ring_schedule(n, 1)
+
+    def partial_for(dst):
+        start = (0, dst * s_l) + (0,) * (y.ndim - 2)
+        y_chunk = lax.dynamic_slice(
+            y, start, (y.shape[0], s_l) + y.shape[2:])
+        return _chunk_dot(y_chunk, w, dims, quant=spec.quant,
+                          sr_lhs=sr_lhs)
+
+    # classic ring reduce-scatter: the accumulator for chunk p starts at
+    # device p+1 and travels home, collecting every device's partial —
+    # at step i, device q folds in its partial for chunk (q + n-1-i) % n
+    acc = partial_for((my + n - 1) % n)
+    for i in range(1, n):
+        acc = lax.ppermute(acc, axis, perm)
+        acc = acc + partial_for((my + n - 1 - i) % n)
+    return acc
+
+
+def _dw_ring_shard(ring, resident, spec: _OverlapSpec, *, ring_is_lhs,
+                   sr_ring=False, sr_resident=False):
+    """The shared weight-gradient ring: ``ring`` [b, s_l, A] is the
+    seq-split operand (rotates), ``resident`` [b, s_l·n, *B] stays put;
+    each hop contracts the visiting block against the resident rows it
+    corresponds to, accumulating the local dw partial [A, *B] (or
+    [*B, A] with ``ring_is_lhs=False``). The sum over the batch/seq
+    axes — DDP's gradient reduce for this weight — is inserted by
+    shard_map's transpose when the cotangent crosses the region boundary
+    (those axes are unmentioned in the weight's in_spec), which issues
+    it HERE, at this layer's backward, rather than batched at the end:
+    the early-reduce ordering of the ISSUE's part (b)."""
+    axis = spec.axis_name
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, s_l, _ = ring.shape
+    dims = (((0, 1), (0, 1)), ((), ()))
+    perm = ring_schedule(n, 1)
+    blk = ring
+    acc = None
+    for i in range(n):
+        src = (my - i) % n
+        rows = lax.dynamic_slice(
+            resident, (0, src * s_l) + (0,) * (resident.ndim - 2),
+            (b, s_l) + resident.shape[2:])
+        if ring_is_lhs:
+            d = _chunk_dot(blk, rows, dims, quant=spec.quant,
+                           sr_lhs=sr_ring, sr_rhs=sr_resident)
+        else:
+            d = _chunk_dot(rows, blk, dims, quant=spec.quant,
+                           sr_lhs=sr_resident, sr_rhs=sr_ring)
+        acc = d if acc is None else acc + d
+        if i != n - 1:
+            blk = lax.ppermute(blk, axis, perm)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# the per-shard cores (custom_vjp INSIDE the manual region)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _column_core(x, w, spec: _OverlapSpec):
+    return _ag_matmul_shard(x, w, spec)
+
+
+def _column_core_fwd(x, w, spec: _OverlapSpec):
+    return _ag_matmul_shard(x, w, spec), (x, w)
+
+
+def _column_core_bwd(spec: _OverlapSpec, res, g):
+    x, w = res
+    bspec = _bwd_quant(spec)
+    sr = bspec.quant is not None  # stochastic-round the gradient operand
+    # dx = RS-ring(g · w over w's free dims): the forward gather's
+    # transpose — g's trailing dims contract with w's trailing dims
+    w_free = tuple(range(1, w.ndim))
+    g_dims = tuple(range(2, 2 + len(w_free)))
+    dx = _matmul_rs_shard(g, w, g_dims, w_free, bspec, sr_lhs=sr)
+    # dw = AG(x)^T · g, as the ring that rotates x against resident g;
+    # the batch/seq-axis psum happens in shard_map's transpose
+    dw = _dw_ring_shard(x, g, bspec, ring_is_lhs=True, sr_resident=sr)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_column_core.defvjp(_column_core_fwd, _column_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _row_core(x, w, spec: _OverlapSpec):
+    return _matmul_rs_shard(x, w, (2,), (0,), spec)
+
+
+def _row_core_fwd(x, w, spec: _OverlapSpec):
+    return _matmul_rs_shard(x, w, (2,), (0,), spec), (x, w)
+
+
+def _row_core_bwd(spec: _OverlapSpec, res, g):
+    x, w = res
+    bspec = _bwd_quant(spec)
+    sr = bspec.quant is not None
+    # dx = AG-ring(g) · w^T: the gradient travels (int8 payload under
+    # full int8 mode); the local transpose of the resident shard is free
+    dx = _ag_matmul_shard(g, jnp.swapaxes(w, 0, 1), bspec, sr_ring=sr)
+    # dw = x^T · AG(g): rotate g against resident x, output [F_local, e]
+    dw = _dw_ring_shard(g, x, bspec, ring_is_lhs=False, sr_ring=sr)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_row_core.defvjp(_row_core_fwd, _row_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API (global arrays in, global arrays out)
+# ---------------------------------------------------------------------------
+
+
+def _seq_split(spec: _OverlapSpec):
+    """The seq-dim entry/exit spec: split over the context axis AND the
+    ring axis (the ring's chunk dimension). Splitting a
+    tensor-replicated activation this way is a local slice, not a
+    collective."""
+    return (Axis.SEQ, spec.axis_name)
+
+
+def ring_column_matmul(x, w, *, mesh, axis_name: str = Axis.TENSOR,
+                       quant: str | None = None,
+                       preferred_element_type=None):
+    """``x @ w`` (x [b, s, e], w [e, *f]) with w's trailing feature dim
+    sharded over ``axis_name``: the all-gather→matmul ring. Output
+    [b, s, *f], feature-sharded over the ring axis at the boundary."""
+    spec = _OverlapSpec(axis_name,
+                        None if quant in (None, "none") else quant)
+    fn = jax.shard_map(
+        functools.partial(_column_core, spec=spec),
+        mesh=mesh,
+        in_specs=(P(_BATCH, _seq_split(spec), None),
+                  P(*((None,) * (w.ndim - 1) + (axis_name,)))),
+        out_specs=P(*((_BATCH, Axis.SEQ) + (None,) * (w.ndim - 2)
+                      + (axis_name,))),
+        check_vma=False,
+    )
+    out_dtype = (jnp.promote_types(x.dtype, w.dtype)
+                 if preferred_element_type is None
+                 else np.dtype(preferred_element_type))
+    return fn(x, w).astype(out_dtype)
+
+
+def ring_row_matmul(x, w, *, mesh, axis_name: str = Axis.TENSOR,
+                    quant: str | None = None,
+                    preferred_element_type=None):
+    """``x @ w`` (x [b, s, F], w [F, e]) with the contraction dim F
+    sharded over ``axis_name``: the matmul→reduce-scatter ring. Output
+    [b, s, e], seq-split over the ring axis at the boundary (the
+    partitioner re-gathers — async under the overlap scheduler flags —
+    where downstream consumes it replicated)."""
+    spec = _OverlapSpec(axis_name,
+                        None if quant in (None, "none") else quant)
+    fn = jax.shard_map(
+        functools.partial(_row_core, spec=spec),
+        mesh=mesh,
+        in_specs=(P(_BATCH, Axis.SEQ, axis_name), P(axis_name, None)),
+        out_specs=P(_BATCH, _seq_split(spec), None),
+        check_vma=False,
+    )
+    out_dtype = (jnp.promote_types(x.dtype, w.dtype)
+                 if preferred_element_type is None
+                 else np.dtype(preferred_element_type))
+    return fn(x, w).astype(out_dtype)
+
+
+def ring_divisibility(x_shape, w_shape, mesh, axis_name: str,
+                      kind: str) -> bool:
+    """Static check that the ring decomposition tiles these shapes on
+    this mesh: seq must split over (seq × ring) chunks, the batch over
+    the data axes, and the sharded weight dim over the ring. Callers
+    fall back to the monolithic matmul when False (decode's s=1 and
+    ragged eval widths land here), so the knob can never turn a valid
+    program into a shape error."""
+    if axis_name not in mesh.shape:
+        return False
+    n = mesh.shape[axis_name]
+    if n <= 1 or len(x_shape) != 3:
+        return False
+    b, s, _ = x_shape
+    data = mesh.shape.get(Axis.DATA, 1) * mesh.shape.get(Axis.FSDP, 1)
+    seq = mesh.shape.get(Axis.SEQ, 1)
+    if b % data or s % (seq * n) or (s // (seq * n)) == 0:
+        return False
+    sharded_dim = w_shape[-1] if kind == "column" else w_shape[0]
+    if kind == "row" and (len(w_shape) != 2 or x_shape[-1] != w_shape[0]):
+        return False
+    return sharded_dim % n == 0

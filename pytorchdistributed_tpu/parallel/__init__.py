@@ -1,6 +1,10 @@
+from pytorchdistributed_tpu.parallel.overlap import (  # noqa: F401
+    overlap_dot_general,
+    validate_overlap,
+)
+from pytorchdistributed_tpu.parallel.precision import Policy  # noqa: F401
 from pytorchdistributed_tpu.parallel.sharding import (  # noqa: F401
     fsdp_param_shardings,
     replicated_shardings,
     shardings_for_strategy,
 )
-from pytorchdistributed_tpu.parallel.precision import Policy  # noqa: F401

@@ -1,0 +1,120 @@
+"""Overlap integration layer: route TP projections through the ring
+collective matmuls (ops/overlap.py) as injectable ``dot_general``s.
+
+The model zoo already funnels every weight matmul through an injectable
+contraction (``flax.linen.Dense(dot_general=...)`` /
+``jnp.einsum(_dot_general=...)`` — the channel ops/quant.py established).
+This module supplies the overlap-aware injectable: a ``dot_general``
+drop-in that, at trace time, looks at the ambient mesh
+(``jax.set_mesh``, the same contract ring attention uses) and routes the
+contraction through the all-gather→matmul or matmul→reduce-scatter ring
+when a ring applies — a tp axis of size > 1, a plain last-dim⋅first-dim
+contraction, and shapes that tile the ring — and otherwise falls
+back to the exact monolithic path (the quantized dot under ``--quant``,
+``lax.dot_general`` otherwise). The fallback is what makes the knob
+safe: decode's s=1 steps, the GPipe stage bodies (already inside a
+shard_map — a nested manual region cannot open another), toy shapes and
+tensor-less meshes all degrade to today's program, never to an error.
+
+``kind`` says which operand carries the tp shard:
+
+  * "column" — w's trailing feature dim is tensor-sharded (QKV / q / kv
+    fused projections, MLP wi): the all-gather→matmul ring.
+  * "row" — the contraction dim is tensor-sharded (attention out
+    projection, MLP wo): the matmul→reduce-scatter ring.
+
+Cached per (kind, quant) so every call site shares ONE callable — flax
+module attributes and jit caches key on identity, exactly like
+quant.quantized_dot_general.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+from pytorchdistributed_tpu.ops.overlap import (
+    ring_column_matmul,
+    ring_divisibility,
+    ring_row_matmul,
+)
+from pytorchdistributed_tpu.ops.quant import dot_general_for
+
+OVERLAP_MODES = ("ring", "xla", "off")
+
+_SIMPLE_DIMS_BATCH = ((), ())
+
+
+def validate_overlap(overlap: str) -> str:
+    if overlap not in OVERLAP_MODES:
+        raise ValueError(f"unknown overlap {overlap!r}; "
+                         f"one of {OVERLAP_MODES}")
+    return overlap
+
+
+def _ambient_mesh():
+    """The mesh the trace runs under (jax.set_mesh / the legacy
+    thread-local the compat shim reads back); None when absent or
+    axis-less — the ring then falls back monolithic."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:  # pragma: no cover - defensive: no mesh machinery
+        return None
+    if mesh is None or not getattr(mesh, "axis_names", ()):
+        return None
+    return mesh
+
+
+@functools.lru_cache(maxsize=None)
+def overlap_dot_general(kind: str, quant: str = "none"):
+    """The overlap-aware ``lax.dot_general`` drop-in for one site kind.
+
+    Signature-compatible with the real dot_general (``precision`` is
+    accepted and ignored, like the quant injectable); the ring engages
+    only for the projection-shaped contraction
+    ``(((lhs.ndim-1,), (0,)), ((), ()))`` on a rank-3 activation whose
+    shapes tile the ambient mesh's tensor axis."""
+    if kind not in ("column", "row"):
+        raise ValueError(f"unknown overlap site kind {kind!r}; "
+                         f"'column' or 'row'")
+    fallback = dot_general_for(quant) or lax.dot_general
+
+    def dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None):
+        (lc, rc), (lb, rb) = dimension_numbers
+        simple = (tuple(map(int, lc)) == (lhs.ndim - 1,)
+                  and tuple(map(int, rc)) == (0,)
+                  and (tuple(lb), tuple(rb)) == _SIMPLE_DIMS_BATCH)
+        mesh = _ambient_mesh() if simple else None
+        if mesh is None or not ring_divisibility(
+                lhs.shape, rhs.shape, mesh, "tensor", kind):
+            if fallback is lax.dot_general:
+                return lax.dot_general(
+                    lhs, rhs, dimension_numbers, precision=precision,
+                    preferred_element_type=preferred_element_type)
+            return fallback(
+                lhs, rhs, dimension_numbers,
+                preferred_element_type=preferred_element_type)
+        ring = ring_column_matmul if kind == "column" else ring_row_matmul
+        return ring(lhs, rhs, mesh=mesh, quant=quant,
+                    preferred_element_type=preferred_element_type)
+
+    dot_general.__name__ = f"overlap_{kind}_dot_general_{quant}"
+    dot_general.__qualname__ = dot_general.__name__
+    return dot_general
+
+
+def site_dot_general(cfg, kind: str, default=None):
+    """The per-site contraction for a TransformerConfig: the ring-routing
+    injectable when ``cfg.overlap == "ring"`` applies to this config (no
+    decode — s=1 ticks can't ring; no pipeline — stage bodies already
+    run inside a manual region), else the quant injectable / ``default``
+    exactly as before. The single accessor transformer.py's projection
+    sites call, so the overlap and quant flags stay in lockstep."""
+    if (getattr(cfg, "overlap", "xla") == "ring"
+            and not getattr(cfg, "decode", False)
+            and getattr(cfg, "pipeline_stages", 1) <= 1):
+        return overlap_dot_general(kind, cfg.quant)
+    return dot_general_for(cfg.quant) or default

@@ -12,10 +12,13 @@ launcher's ``--telemetry-dir`` / PTD_TELEMETRY_DIR env).
 """
 
 from pytorchdistributed_tpu.telemetry.accounting import (  # noqa: F401
+    CPU_SIM_NOMINAL_ICI_BYTES_PER_S,
     CPU_SIM_NOMINAL_PEAK_FLOPS,
+    ICI_BYTES_PER_S,
     PEAK_BF16_FLOPS,
     StepAccounting,
     device_memory_highwater,
+    ici_bytes_per_s_for,
     peak_flops_for,
 )
 from pytorchdistributed_tpu.telemetry.events import (  # noqa: F401

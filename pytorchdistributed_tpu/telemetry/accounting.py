@@ -50,6 +50,42 @@ PEAK_BF16_FLOPS = {
 # so no report can pass a sim MFU off as utilization of real hardware.
 CPU_SIM_NOMINAL_PEAK_FLOPS = 1e12
 
+# Nominal aggregate ICI bandwidth per chip (bytes/s, all links), by jax
+# device_kind — the comm_stall_frac denominator. These are public
+# per-chip interconnect aggregates (v4 ≈ 2.4 Tb/s, v5e ≈ 1.6 Tb/s,
+# v5p ≈ 4.8 Tb/s, v6e ≈ 3.6 Tb/s), NOT an achievable-bandwidth model:
+# comm_stall_frac is an order-of-magnitude stall estimator and says so
+# via ici_source, the same labeling discipline as the MFU peak table.
+ICI_BYTES_PER_S = {
+    "TPU v4": 3.0e11,
+    "TPU v5 lite": 2.0e11,
+    "TPU v5e": 2.0e11,
+    "TPU v5": 6.0e11,
+    "TPU v5p": 6.0e11,
+    "TPU v6 lite": 4.5e11,
+    "TPU v6e": 4.5e11,
+}
+
+# CPU-sim stand-in ICI (nominal 10 GB/s): meaningless absolutely, but it
+# makes comm_stall_frac computable and DETERMINISTIC from the compiled
+# artifact alone — which is what lets the structural compiled-invariant
+# tier pin it (tests/test_compiled_invariants.py).
+CPU_SIM_NOMINAL_ICI_BYTES_PER_S = 1e10
+
+
+def ici_bytes_per_s_for(device_kind: str,
+                        platform: str | None = None,
+                        ) -> tuple[float | None, str]:
+    """(per-chip nominal ICI bytes/s, source label) — comm_stall_frac's
+    denominator, labeled like peak_flops_for so a sim estimate can never
+    read as a hardware one."""
+    bw = ICI_BYTES_PER_S.get(device_kind)
+    if bw is not None:
+        return bw, device_kind
+    if platform == "cpu" or device_kind == "cpu":
+        return CPU_SIM_NOMINAL_ICI_BYTES_PER_S, "cpu-sim-nominal"
+    return None, f"unknown:{device_kind}"
+
 
 def peak_flops_for(device_kind: str,
                    platform: str | None = None) -> tuple[float | None, str]:
@@ -102,6 +138,11 @@ class StepAccounting:
     peak_flops_per_device: float | None
     peak_source: str
     n_devices: int
+    # ICI denominator for comm_stall_frac. Defaults keep accounting.json
+    # files written before ISSUE 5 loading (from_json passes only the
+    # recorded keys).
+    ici_bytes_per_s: float | None = None
+    ici_source: str = ""
 
     @classmethod
     def from_compiled(cls, compiled, *, batch, n_devices: int | None = None,
@@ -119,6 +160,7 @@ class StepAccounting:
         tokens, samples = _batch_tokens_samples(batch)
         dev = jax.devices()[0]
         peak, source = peak_flops_for(dev.device_kind, dev.platform)
+        ici, ici_source = ici_bytes_per_s_for(dev.device_kind, dev.platform)
         return cls(
             model_flops_per_step=float(cost.get("flops", 0.0)),
             comm_bytes_per_step=int(sum(by_op.values())),
@@ -129,6 +171,8 @@ class StepAccounting:
             peak_source=source,
             n_devices=(n_devices if n_devices is not None
                        else jax.device_count()),
+            ici_bytes_per_s=ici,
+            ici_source=ici_source,
         )
 
     # -- derived metrics ---------------------------------------------------
@@ -151,6 +195,36 @@ class StepAccounting:
         if sec_per_step <= 0:
             return None
         return round(self.comm_bytes_per_step / sec_per_step, 1)
+
+    def comm_stall_frac(self, sec_per_step: float | None = None,
+                        ) -> float | None:
+        """Estimated fraction of the step stalled on collectives — the
+        zero-overlap UPPER BOUND (ISSUE 5c): the time the step's
+        per-device collective bytes would take at the chip's nominal ICI
+        bandwidth, as a fraction of the step. With a measured
+        ``sec_per_step`` (the Trainer/bench path) the denominator is the
+        real step; without one (the structural compiled-invariant pins)
+        it is the estimated serial compute + comm time at nominal peaks,
+        so the number is a deterministic function of the compiled
+        artifact. A step whose measured comm_stall_frac sits well below
+        the structural estimate is one whose collectives the scheduler
+        actually hid — read it next to utils.hlo.overlap_census, which
+        says how (async pairs, ops inside the windows). ``ici_source``
+        labels the denominator; cpu-sim-nominal estimates are for
+        regression-pinning, not performance claims."""
+        if self.ici_bytes_per_s is None:
+            return None
+        comm_s = self.comm_bytes_per_step / self.ici_bytes_per_s
+        if sec_per_step is not None:
+            if sec_per_step <= 0:
+                return None
+            return round(min(1.0, comm_s / sec_per_step), 4)
+        if self.peak_flops_per_device is None or self.model_flops_per_step <= 0:
+            return None
+        compute_s = self.model_flops_per_step / self.peak_flops_per_device
+        if comm_s + compute_s <= 0:
+            return None
+        return round(comm_s / (comm_s + compute_s), 4)
 
     # -- (de)serialization -------------------------------------------------
 

@@ -91,6 +91,38 @@ BN_EMA_MOMENTUM = 0.9
 # far under physical VMEM on v4+ (~128 MiB on v5e).
 _TPU_COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "24576"}
 
+# Latency-hiding scheduler wiring (ISSUE 5b): make XLA start collectives
+# asynchronously and schedule independent compute inside the
+# start→done window — the DDP bucketed-Reducer overlap, as compiler
+# scheduling. Concretely: the gradient all-reduce/reduce-scatter of
+# EARLY layers can issue while later layers' backward still runs (dp/
+# fsdp), and the TP activation collectives overlap the surrounding
+# matmuls. This is the "xla" half of the overlap knob; the "ring" half
+# (ops/overlap.py) decomposes the TP matmuls by hand on top of it.
+# TPU-only (the CPU sim's collectives are synchronous rendezvous — these
+# options are no-ops-at-best there, and the compiled-invariant pins must
+# not move); verified via utils.hlo.overlap_census on the compiled HLO
+# (async start/done pairing + ops scheduled between).
+_TPU_OVERLAP_COMPILER_OPTIONS = {
+    "xla_tpu_enable_latency_hiding_scheduler": "true",
+    "xla_tpu_overlap_compute_collective_tc": "true",
+    "xla_enable_async_all_gather": "true",
+    "xla_tpu_enable_async_collective_fusion": "true",
+    "xla_tpu_enable_async_collective_fusion_fuse_all_gather": "true",
+    "xla_tpu_enable_async_collective_fusion_multiple_steps": "true",
+}
+
+
+def _overlap_compiler_options(overlap: str) -> dict[str, str]:
+    """The scheduler-flag half of Trainer(overlap=...): "xla"/"ring" wire
+    the latency-hiding scheduler on TPU; "off" (the measured monolithic
+    baseline) and non-TPU backends add nothing."""
+    import jax as _jax
+
+    if overlap == "off" or _jax.default_backend() != "tpu":
+        return {}
+    return dict(_TPU_OVERLAP_COMPILER_OPTIONS)
+
 
 def _default_compiler_options() -> dict[str, str] | None:
     """The raised scoped-VMEM default, gated on TPU GENERATION (ADVICE
@@ -183,6 +215,8 @@ class Trainer:
         metrics_file: str | None = None,
         compiler_options: dict[str, str] | None = None,
         telemetry_dir: str | None = None,
+        overlap: str = "xla",
+        prefetch: int | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -193,12 +227,27 @@ class Trainer:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps
+        # Collective-overlap mode (ISSUE 5): "xla"/"ring" wire the TPU
+        # latency-hiding scheduler flags into the step's compile options
+        # (the model-side ring routing is TransformerConfig.overlap);
+        # "off" is the measured monolithic baseline.
+        from pytorchdistributed_tpu.parallel.overlap import validate_overlap
+        self.overlap = validate_overlap(overlap)
+        # Device prefetch depth (per-batch H2D double-buffering): the
+        # explicit arg wins, then the PTD_PREFETCH env contract, then the
+        # loader default of 2. Depth 0 = fully synchronous transfer.
+        if prefetch is None:
+            prefetch = int(os.environ.get("PTD_PREFETCH", "2"))
+        if prefetch < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {prefetch}")
+        self.prefetch = prefetch
         # User options MERGE OVER the backend defaults — a caller tuning an
         # unrelated flag must not silently drop the scoped-VMEM fix (to
         # override a default, set its key explicitly, e.g.
         # {"xla_tpu_scoped_vmem_limit_kib": "16384"} restores the XLA
         # default and with it the S=4096 compile abort).
         defaults = _default_compiler_options() or {}
+        defaults.update(_overlap_compiler_options(self.overlap))
         self._compiler_options = {**defaults, **(compiler_options or {})}
         if not self._compiler_options:
             self._compiler_options = None  # jit expects None, not {}
@@ -765,7 +814,7 @@ class Trainer:
         if self._tracer is not None:
             raw = self._spanned_iter(raw)
         it = prefetch_to_device(raw, self.batch_sharding,
-                                tracer=self._tracer)
+                                size=self.prefetch, tracer=self._tracer)
         try:
             for i, batch in enumerate(it, start=skip_steps):
                 if self.state is None:
@@ -889,6 +938,9 @@ class Trainer:
         if mfu is not None:
             vals["mfu"] = mfu
         vals["comm_bytes_per_step"] = self.accounting.comm_bytes_per_step
+        stall = self.accounting.comm_stall_frac(sec)
+        if stall is not None:
+            vals["comm_stall_frac"] = stall
         hw = device_memory_highwater()
         if hw is not None:
             vals["device_peak_mem_bytes"] = hw
@@ -1022,7 +1074,8 @@ class Trainer:
 
         weight_fold_checked = False
         for i, batch in enumerate(
-                prefetch_to_device(batches(), self.batch_sharding)):
+                prefetch_to_device(batches(), self.batch_sharding,
+                                   size=self.prefetch)):
             metrics = self._eval_raw(batch)
             if padded and not weight_fold_checked and probe_flags[i]:
                 # The sample_weight contract guard (VERDICT r4 weak #5):

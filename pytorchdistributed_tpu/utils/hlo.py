@@ -154,6 +154,80 @@ def collective_bytes(hlo_text: str) -> dict[str, int]:
     return out
 
 
+# one async pair: `%name = ... <op>-start(...)` later consumed by
+# `<op>-done(...%name...)`. Matched by value name within the module text —
+# HLO instruction names are unique per computation and the pair never
+# crosses one. The type between `=` and the op is usually a TUPLE with
+# internal spaces (`(f32[8]{0}, f32[8]{0}) all-gather-start(...)` — the
+# staging tuple every async start returns), so the shape alternation
+# mirrors _COLL_DEF_RE's rather than assuming one token.
+_ASYNC_START_RE = re.compile(
+    r"%?([\w.\-]+)\s*=\s*(?:\((?:[^()]|\([^()]*\))*\)|\S+)\s+("
+    + "|".join(sorted(COLLECTIVE_OPS, key=len, reverse=True))
+    + r")-start\(")
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s")
+
+
+def overlap_census(hlo_text: str) -> dict:
+    """Census of the latency-hiding structure of an optimized HLO module
+    (ISSUE 5c) — the compile-time evidence for the overlap the scheduler
+    flags (trainer._TPU_OVERLAP_COMPILER_OPTIONS) and the ring matmuls
+    (ops/overlap.py) claim:
+
+      * ``async_pairs`` — per collective kind, how many ``-start`` ops
+        have a matching ``-done`` (on TPU with the latency-hiding
+        scheduler every collective should pair; XLA:CPU lowers most
+        collectives synchronously, so sim programs legitimately show 0);
+      * ``unpaired_starts`` — starts with no done: must be 0 in any
+        well-formed module, a nonzero value means the census regexes
+        (or the compiler) broke;
+      * ``overlapped_ops`` — instructions scheduled strictly BETWEEN a
+        start and its done, summed over pairs: the work the scheduler
+        actually placed inside collective windows. Post-scheduling HLO
+        text is in execution order, so text distance is schedule
+        distance; 0 with nonzero pairs means the async pair is
+        vestigial (nothing hidden);
+      * ``ppermute`` — collective-permute count (async starts count
+        once): the chunked collective-matmul signature. Each ring
+        contributes exactly (ring_size - 1) hops per traveling operand,
+        which is what tests/test_overlap.py pins against the tp size.
+    """
+    starts: dict[str, tuple[str, int]] = {}
+    pairs = {op: 0 for op in COLLECTIVE_OPS}
+    overlapped = 0
+    instr_idx = 0
+    for line in hlo_text.splitlines():
+        is_instr = bool(_INSTR_RE.match(line))
+        if is_instr:
+            instr_idx += 1
+        m = _ASYNC_START_RE.search(line)
+        if m:
+            starts[m.group(1)] = (m.group(2), instr_idx)
+            continue
+        done = re.search(r"[\w\-]+-done\(", line)
+        if done:
+            # the done's single operand is the start value; real dumps
+            # print it behind its (possibly tuple) shape and with or
+            # without the legacy '%' sigil (`all-gather-done((f32[8],
+            # f32[16]) %ag.1)`), so rather than parse shape grammar,
+            # take the first token that names a recorded start — shape
+            # tokens (`f32`, dims) can never collide with instruction
+            # names like `all-gather-start.1`
+            for tok in re.findall(r"[\w.\-]+", line[done.end():]):
+                if tok in starts:
+                    op, start_idx = starts.pop(tok)
+                    pairs[op] += 1
+                    overlapped += max(0, instr_idx - start_idx - 1)
+                    break
+    return {
+        "async_pairs": pairs,
+        "unpaired_starts": len(starts),
+        "overlapped_ops": overlapped,
+        "ppermute": len(re.findall(
+            r"collective-permute(?:-start)?\(", hlo_text)),
+    }
+
+
 def int8_counts(hlo_text: str) -> dict[str, int]:
     """Census of the int8 quantized-matmul op mix (ops/quant.py):
     ``s8_values`` — instructions producing an s8 tensor (the per-operand
@@ -192,6 +266,9 @@ def compiled_invariants(compiled) -> dict:
       them makes MFU / comm-volume math a CI tripwire: a partitioning
       change that moves communication volume — or an accounting bug
       that would misreport MFU — fails against the pinned numbers.
+    * ``overlap`` — `overlap_census`: async start/done pairing, ops
+      scheduled inside collective windows, and the ppermute ring count
+      (the chunked collective-matmul signature — ISSUE 5).
     """
     mem = compiled.memory_analysis()
     cost = compiled.cost_analysis() or {}
@@ -206,4 +283,5 @@ def compiled_invariants(compiled) -> dict:
         "collectives": collective_counts(text),
         "int8_ops": int8_counts(text),
         "comm_bytes": collective_bytes(text),
+        "overlap": overlap_census(text),
     }

@@ -179,6 +179,17 @@ BUILDERS = {
     "dp8_int8fwd": _structural(dict(quant="int8_fwd"), dict(data=8), "dp"),
     "tp4_dp2_int8fwd": _structural(dict(quant="int8_fwd"),
                                    dict(data=2, tensor=4), "tp"),
+    # the ring collective-matmul step (ISSUE 5): same tp x dp program
+    # with the QKV/out/MLP projections decomposed into ppermute rings —
+    # the "overlap" census pins the ring signature (12 rings per block
+    # body x (tp-1)=3 hops, on top of the partitioner's own permutes),
+    # and its int8 twin pins the quantized-payload composition (the
+    # gather ring ships s8 + fp32 scales)
+    "tp4_dp2_ring": _structural(dict(overlap="ring"),
+                                dict(data=2, tensor=4), "tp"),
+    "tp4_dp2_ring_int8fwd": _structural(
+        dict(overlap="ring", quant="int8_fwd"),
+        dict(data=2, tensor=4), "tp"),
     "pp4_1f1b": _structural(
         dict(num_layers=4, pipeline_stages=4, pipeline_microbatches=8,
              pp_schedule="1f1b"),
@@ -216,6 +227,7 @@ BUILDERS = {
 }
 
 QUICK_NAMES = ("dp8", "fsdp8", "tp4_dp2", "dp8_int8fwd", "tp4_dp2_int8fwd",
+               "tp4_dp2_ring", "tp4_dp2_ring_int8fwd",
                "pp4_1f1b", "ring_seq2", "ulysses_seq2", "moe_ep4")
 
 # Captured by scripts/capture_invariants.py on the frozen image's
@@ -311,6 +323,49 @@ COMMITTED: dict[str, dict] = {
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 25, "int_dots": 5},
         "comm_bytes": {'all-reduce': 1463236, 'all-gather': 1658880, 'reduce-scatter': 0, 'collective-permute': 24576, 'all-to-all': 524288, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
+    },
+    # the ring collective-matmul signatures (ISSUE 5), captured
+    # 2026-08-04 on this image. What the numbers say: collective-permute
+    # 41 = the partitioner's own 5 (as in tp4_dp2) + 12 rings x (tp-1)=3
+    # hops — 4 projection sites (qkv/out/wi/wo) x 3 rings each (fwd,
+    # bwd-dx, bwd-dw) in the one scanned block body; the monolithic
+    # census's all-gather 11 / all-to-all 4 collapse to 5 / 1 because the
+    # gathers now ride the rings. The int8 twin adds 6 permutes (the two
+    # column fwd rings ship a second array — the fp32 row scales next to
+    # the s8 payload) yet its ppermute BYTES drop 2383872 → 2095104: the
+    # int8 payload is a quarter the fp32 chunk, the ISSUE's comm-bytes÷4
+    # claim in census form. int8_ops 34/17 > the monolithic tp twin's
+    # 25/5: every ring chunk is its own int8 dot (12 int dots across the
+    # 4 sites' rings + the LM-head/CE sites), the per-chunk scales are
+    # extra s8-producing converts. flops sit ~14% over tp4_dp2 — the
+    # fp32 ring accumulators and dynamic-update-slices the cost model
+    # bills; the MXU-rate win this buys is a hardware question the bench
+    # A/B (PTD_OVERLAP) answers, not the sim.
+    "tp4_dp2_ring": {
+        "flops": 153246608.0,
+        "temp_bytes": 8630152,
+        "arg_bytes": 439432,
+        "alias_bytes": 431240,
+        "collectives": {"all-reduce": 33, "all-gather": 5,
+                        "reduce-scatter": 0, "collective-permute": 41,
+                        "all-to-all": 1, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {'all-reduce': 623048, 'all-gather': 163840, 'reduce-scatter': 0, 'collective-permute': 2383872, 'all-to-all': 131072, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
+        "overlap": {'async_pairs': {'all-reduce': 0, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0}, 'unpaired_starts': 0, 'overlapped_ops': 0, 'ppermute': 41},
+    },
+    "tp4_dp2_ring_int8fwd": {
+        "flops": 159973456.0,
+        "temp_bytes": 8599968,
+        "arg_bytes": 439432,
+        "alias_bytes": 431240,
+        "collectives": {"all-reduce": 33, "all-gather": 7,
+                        "reduce-scatter": 0, "collective-permute": 47,
+                        "all-to-all": 1, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 34, "int_dots": 17},
+        "comm_bytes": {'all-reduce': 623048, 'all-gather': 172544, 'reduce-scatter': 0, 'collective-permute': 2095104, 'all-to-all': 131072, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
+        "overlap": {'async_pairs': {'all-reduce': 0, 'all-gather': 0, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0}, 'unpaired_starts': 0, 'overlapped_ops': 0, 'ppermute': 47},
     },
     # r5 entry KEPT (not capturable on this image — partial-auto
     # shard_map; the test skips with that reason rather than failing)
@@ -487,6 +542,13 @@ def _assert_invariants(name, inv, want):
             f"committed {want['int8_ops']} — a quantized site silently "
             f"falling back to bf16 (or an int8 op leaking into a bf16 "
             f"config) shows up exactly here")
+    if "overlap" in want:
+        assert inv["overlap"] == want["overlap"], (
+            f"{name}: overlap census changed: got {inv['overlap']}, "
+            f"committed {want['overlap']} — the ppermute ring count / "
+            f"async pairing signature of the latency-hiding path (a ring "
+            f"site silently falling back to the monolithic collective, "
+            f"or a hop appearing/vanishing, shows up exactly here)")
     if "comm_bytes" in want:
         assert inv["comm_bytes"] == want["comm_bytes"], (
             f"{name}: per-device collective bytes changed: got "
@@ -708,6 +770,46 @@ SERVE_COMMITTED: dict[str, dict] = {
 def test_serving_invariants(name):
     inv = compiled_invariants(serving_lowered(name).compile())
     _assert_invariants(name, inv, SERVE_COMMITTED[name])
+
+
+# comm_stall_frac (telemetry/accounting.py, ISSUE 5c) computed from the
+# compiled artifact alone — comm bytes at the nominal ICI table over
+# comm + compute at nominal peaks, cpu-sim-nominal denominators on this
+# rig — so the estimator itself is pinnable: a change to the ICI table,
+# the byte census, or the stall formula moves these numbers. Captured
+# 2026-08-04; the ring config's LOWER stall vs its monolithic twin
+# (0.683 < 0.7473) is a census-level win of the decomposition before
+# any scheduling effect: each ring hop bills one seq chunk where the
+# monolithic all-gather/all-to-all billed whole gathered buffers.
+STALL_COMMITTED = {
+    "dp8": 0.177,
+    "fsdp8": 0.8248,
+    "tp4_dp2": 0.7473,
+    "tp4_dp2_ring": 0.683,
+}
+
+
+@pytest.mark.parametrize("name", sorted(STALL_COMMITTED))
+def test_comm_stall_frac_pinned(name):
+    """The structural comm-stall estimator, end to end: lower the step,
+    build StepAccounting from the executable, assert the zero-overlap
+    stall fraction against the committed value. Also pins the measured
+    variant's arithmetic (a fixed fake step time) so both denominators
+    of comm_stall_frac are covered."""
+    from pytorchdistributed_tpu.telemetry import StepAccounting
+
+    trainer, batch = BUILDERS[name]()
+    acct = StepAccounting.from_compiled(
+        trainer.lower_step(batch).compile(), batch=batch,
+        n_devices=trainer.mesh.devices.size)
+    assert acct.ici_source == "cpu-sim-nominal"
+    assert acct.comm_stall_frac() == STALL_COMMITTED[name]
+    # measured-denominator variant: bytes / ici / sec, capped at 1
+    sec = 0.010
+    want = round(min(1.0, acct.comm_bytes_per_step
+                     / acct.ici_bytes_per_s / sec), 4)
+    assert acct.comm_stall_frac(sec) == want
+    assert acct.comm_stall_frac(0.0) is None
 
 
 def test_analytic_flops_formula_pinned():

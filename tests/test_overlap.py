@@ -1,0 +1,446 @@
+"""Latency-hiding collective-matmul tests (ops/overlap.py, ISSUE 5).
+
+Three bars on the 8-device CPU sim:
+
+  * ring-primitive numerics — forward AND both gradients of the
+    all-gather→matmul and matmul→reduce-scatter rings allclose to the
+    monolithic matmul at fp32 tolerance (the column/dw rings never split
+    a contraction; the row ring's traveling accumulator stays fp32), and
+    the int8 composition reproduces the monolithic quantized dot on the
+    gather side (identical per-row scales — the gathered dim is not
+    contracted);
+  * training parity — overlap="ring" reproduces the overlap="off" loss
+    curve through the full Trainer across dp / fsdp / tp meshes (ring
+    engages only where a tensor axis exists; elsewhere it must be the
+    identity knob), in fp32 exactly and under --quant int8_fwd within
+    the established tolerance, with ZERO steady-state recompiles;
+  * the HLO overlap census — ppermute count == rings × (tp−1) on the
+    compiled ring step, async starts/dones balanced, and the satellite
+    units (ring_schedule, all_to_all validation, prefetch depth).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorchdistributed_tpu.ops.collectives import ring_schedule
+from pytorchdistributed_tpu.ops.overlap import (
+    ring_column_matmul,
+    ring_divisibility,
+    ring_row_matmul,
+)
+from pytorchdistributed_tpu.runtime.mesh import create_mesh
+
+# |ring - monolithic| per-element bound at fp32: reduction-order noise
+# only — grads included (the acceptance criterion's 1e-5, with headroom
+# for the row ring's chunk-sum order against values of O(10)).
+FP32_TOL = 1e-4
+# bf16 loss-curve tolerance for the Trainer parity runs: same bar as the
+# int8 parity suite (test_quant.PARITY_TOL documents the derivation).
+CURVE_TOL = 0.25
+
+
+def _tp_mesh():
+    return create_mesh(data=2, tensor=4)
+
+
+# ---------------------------------------------------------------------------
+# ring-primitive numerics
+# ---------------------------------------------------------------------------
+
+
+class TestRingPrimitives:
+    def _check(self, ring_fn, ref_fn, x, w):
+        mesh = _tp_mesh()
+
+        def ring_loss(x, w):
+            return (ring_fn(x, w, mesh) ** 2).sum()
+
+        def ref_loss(x, w):
+            return (ref_fn(x, w) ** 2).sum()
+
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda x, w: ring_fn(x, w, mesh))(x, w)
+            gx, gw = jax.jit(jax.grad(ring_loss, argnums=(0, 1)))(x, w)
+        ref = ref_fn(x, w)
+        rgx, rgw = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+        scale = float(jnp.abs(ref).max())
+        assert float(jnp.abs(out - ref).max()) <= FP32_TOL * scale
+        for g, r in ((gx, rgx), (gw, rgw)):
+            gs = max(float(jnp.abs(r).max()), 1.0)
+            assert float(jnp.abs(g - r).max()) <= FP32_TOL * gs
+
+    def test_column_matches_monolithic(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 16, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 12)), jnp.float32)
+        self._check(lambda x, w, m: ring_column_matmul(x, w, mesh=m),
+                    lambda x, w: jnp.einsum("bse,ef->bsf", x, w), x, w)
+
+    def test_column_rank3_kernel(self):
+        """The fused QKV / SwiGLU kernel shape [e, stack, f]: the ring
+        contracts it whole (the stack dim is a free dim)."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((4, 16, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 3, 16)), jnp.float32)
+        self._check(lambda x, w, m: ring_column_matmul(x, w, mesh=m),
+                    lambda x, w: jnp.einsum("bse,ecf->bscf", x, w), x, w)
+
+    def test_row_matches_monolithic(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((4, 16, 12)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((12, 8)), jnp.float32)
+        self._check(lambda x, w, m: ring_row_matmul(x, w, mesh=m),
+                    lambda x, w: jnp.einsum("bsf,fe->bse", x, w), x, w)
+
+    def test_column_int8_matches_monolithic_quant(self):
+        """The gather ring pre-quantizes with per-row scales over the
+        contraction dim — the same scales the monolithic quantized dot
+        computes, so the composition reproduces it to fp32 noise; the
+        int8_fwd backward runs full-precision on the saved operands and
+        must match the reference VJP."""
+        from pytorchdistributed_tpu.ops.quant import quantized_dot_general
+
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((4, 16, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 12)), jnp.float32)
+        qd = quantized_dot_general("int8_fwd")
+        dims = (((2,), (0,)), ((), ()))
+        self._check(
+            lambda x, w, m: ring_column_matmul(x, w, mesh=m,
+                                               quant="int8_fwd"),
+            lambda x, w: qd(x, w, dims), x, w)
+
+    def test_row_int8_close_to_monolithic_quant(self):
+        """Row rings quantize over the tensor-SHARDED contraction dim, so
+        scales are per-shard where the monolithic dot's are global —
+        close (int8 noise level), not equal; pinned as a bound so a
+        wrong-axis scale (order-of-magnitude error) still fails."""
+        from pytorchdistributed_tpu.ops.quant import quantized_dot_general
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((4, 16, 12)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((12, 8)), jnp.float32)
+        mesh = _tp_mesh()
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda x, w: ring_row_matmul(
+                x, w, mesh=mesh, quant="int8_fwd"))(x, w)
+        ref = quantized_dot_general("int8_fwd")(x, w, (((2,), (0,)), ((), ())))
+        rel = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
+        assert rel < 0.05, rel
+
+    def test_preferred_element_type(self):
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.standard_normal((4, 16, 8)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((8, 12)), jnp.bfloat16)
+        mesh = _tp_mesh()
+        with jax.set_mesh(mesh):
+            y = jax.jit(lambda x, w: ring_column_matmul(
+                x, w, mesh=mesh))(x, w)
+            y32 = jax.jit(lambda x, w: ring_column_matmul(
+                x, w, mesh=mesh,
+                preferred_element_type=jnp.float32))(x, w)
+        assert y.dtype == jnp.bfloat16
+        assert y32.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# the routing drop-in + divisibility fallbacks
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_divisibility_gates(self):
+        mesh = _tp_mesh()
+        ok = ring_divisibility((4, 16, 8), (8, 12), mesh, "tensor",
+                               "column")
+        assert ok
+        # s=1 (decode tick) / non-tiling seq / feature not divisible
+        assert not ring_divisibility((4, 1, 8), (8, 12), mesh, "tensor",
+                                     "column")
+        assert not ring_divisibility((4, 6, 8), (8, 12), mesh, "tensor",
+                                     "column")
+        assert not ring_divisibility((4, 16, 8), (8, 10), mesh, "tensor",
+                                     "column")
+        assert not ring_divisibility((4, 16, 10), (10, 8), mesh, "tensor",
+                                     "row")
+        # no tensor axis → never rings
+        assert not ring_divisibility((4, 16, 8), (8, 12),
+                                     create_mesh(data=8), "tensor",
+                                     "column")
+
+    def test_dot_general_drop_in_falls_back_without_mesh(self):
+        """Outside any mesh context the injectable must be exactly the
+        plain dot (the knob can never break a meshless call site)."""
+        from pytorchdistributed_tpu.parallel.overlap import (
+            overlap_dot_general,
+        )
+
+        dg = overlap_dot_general("column", "none")
+        x = jnp.ones((2, 4, 8))
+        w = jnp.ones((8, 6))
+        out = dg(x, w, (((2,), (0,)), ((), ())))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(x @ w), rtol=1e-6)
+
+    def test_dot_general_cached_identity(self):
+        from pytorchdistributed_tpu.parallel.overlap import (
+            overlap_dot_general,
+        )
+
+        assert (overlap_dot_general("column", "none")
+                is overlap_dot_general("column", "none"))
+        assert (overlap_dot_general("column", "none")
+                is not overlap_dot_general("row", "none"))
+        with pytest.raises(ValueError):
+            overlap_dot_general("diagonal")
+
+    def test_overlap_config_validation(self):
+        from pytorchdistributed_tpu.models import gpt2_config
+
+        with pytest.raises(ValueError):
+            gpt2_config("test", overlap="rings")
+        from pytorchdistributed_tpu.parallel.overlap import validate_overlap
+
+        with pytest.raises(ValueError):
+            validate_overlap("on")
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level parity: ring vs monolithic loss curves (dp / fsdp / tp)
+# ---------------------------------------------------------------------------
+
+
+def _train_losses(overlap, axes, strategy, *, quant="none", steps=6,
+                  dtype=None):
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+
+    kw = dict(overlap=overlap, quant=quant)
+    if dtype is not None:
+        kw["dtype"] = dtype
+    cfg = gpt2_config("test", **kw)
+    tr = Trainer(GPT2(cfg), optax.adamw(1e-2), token_cross_entropy_loss,
+                 mesh=create_mesh(**axes), strategy=strategy,
+                 log_every=10**9, watchdog=False, overlap=overlap)
+    rng = np.random.default_rng(7)
+    batch = {
+        "tokens": rng.integers(0, 128, (32, 64)).astype(np.int32),
+        "targets": rng.integers(0, 128, (32, 64)).astype(np.int32),
+    }
+    return [float(tr.train_step(batch)["loss"]) for _ in range(steps)], tr
+
+
+def test_parity_tp_fp32_exact():
+    """fp32 model: ring and monolithic curves agree to fp32 noise per
+    step — the acceptance criterion's strict half (bf16 runs get the
+    curve tolerance)."""
+    off, _ = _train_losses("off", dict(data=2, tensor=4), "tp",
+                           dtype=jnp.float32)
+    ring, _ = _train_losses("ring", dict(data=2, tensor=4), "tp",
+                            dtype=jnp.float32)
+    for a, b in zip(off, ring):
+        assert abs(a - b) < 1e-3, (off, ring)
+
+
+@pytest.mark.parametrize("axes,strategy", [
+    (dict(data=8), "dp"),
+    (dict(data=2, fsdp=4), "fsdp"),
+    (dict(data=2, tensor=4), "tp"),
+])
+def test_parity_bf16(axes, strategy):
+    off, _ = _train_losses("off", axes, strategy)
+    ring, _ = _train_losses("ring", axes, strategy)
+    assert ring[-1] < ring[0], f"ring did not learn: {ring}"
+    delta = abs(off[-1] - ring[-1])
+    assert delta < CURVE_TOL, (off, ring)
+    if "tensor" not in axes:
+        # no tp axis: the knob must be the identity — same compiled
+        # program, bitwise-equal curve
+        assert off == ring, (off, ring)
+
+
+def test_parity_tp_int8():
+    """--quant int8_fwd x overlap=ring: the quantized ring step tracks
+    the quantized monolithic step (gather-side scales identical; the
+    row side's per-shard scales are inside int8 noise)."""
+    off, _ = _train_losses("off", dict(data=2, tensor=4), "tp",
+                           quant="int8_fwd")
+    ring, _ = _train_losses("ring", dict(data=2, tensor=4), "tp",
+                            quant="int8_fwd")
+    assert ring[-1] < ring[0], f"quantized ring did not learn: {ring}"
+    assert abs(off[-1] - ring[-1]) < CURVE_TOL, (off, ring)
+
+
+def test_zero_steadystate_recompiles():
+    """The ring step compiles once: repeated steps hit the same pjit
+    cache entry (the serving suite's _cache_size tripwire, applied to
+    the ring-routed train step)."""
+    losses, tr = _train_losses("ring", dict(data=2, tensor=4), "tp",
+                               steps=4)
+    assert tr._step_fn._cache_size() == 1
+    for _ in range(3):
+        tr.train_step({
+            "tokens": np.zeros((32, 64), np.int32),
+            "targets": np.zeros((32, 64), np.int32),
+        })
+    assert tr._step_fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# the HLO overlap census
+# ---------------------------------------------------------------------------
+
+
+def test_ring_census_ppermute_counts():
+    """The compiled ring step's collective-permute count decomposes as
+    baseline + rings x (tp-1): 4 projection sites x 3 rings each (fwd,
+    bwd-dx, bwd-dw) in the scanned block body, each ring contributing
+    exactly tp-1 hops — the acceptance criterion's census assert. The
+    async start/done pairing must be balanced (trivially, on the sim's
+    synchronous lowering; on TPU the same census counts real pairs)."""
+    from pytorchdistributed_tpu.utils.hlo import compiled_invariants
+
+    _, tr_off = _train_losses("off", dict(data=2, tensor=4), "tp", steps=1)
+    _, tr_ring = _train_losses("ring", dict(data=2, tensor=4), "tp",
+                               steps=1)
+    batch = {
+        "tokens": np.zeros((32, 64), np.int32),
+        "targets": np.zeros((32, 64), np.int32),
+    }
+    base = compiled_invariants(tr_off.lower_step(batch).compile())
+    ring = compiled_invariants(tr_ring.lower_step(batch).compile())
+    tp = 4
+    n_rings = 4 * 3  # qkv/out/wi/wo x (fwd, bwd-dx, bwd-dw)
+    extra = ring["overlap"]["ppermute"] - base["overlap"]["ppermute"]
+    assert extra == n_rings * (tp - 1), (base["overlap"], ring["overlap"])
+    assert ring["overlap"]["unpaired_starts"] == 0
+    # async pairing on the gradient reduce: starts and dones balance
+    # (counted pairs are <= the all-reduce census; every start matched)
+    for op, n in ring["overlap"]["async_pairs"].items():
+        assert n <= ring["collectives"][op]
+
+
+def test_overlap_census_parses_async_pairs():
+    """Unit: the census pairs starts/dones by value name and counts the
+    instructions scheduled between them (the hidden window). The text
+    uses the REAL operand syntax this image's `compiled.as_text()`
+    emits — tuple staging types with internal spaces on the starts
+    (every async collective start returns a tuple) and shape-prefixed
+    operands on the dones (`all-gather-done((f32[8], f32[16]) %ag.1)`)
+    — so a parser that assumed one type token or a bare `%name` operand
+    would read 0 pairs on exactly the TPU programs the census exists to
+    verify."""
+    from pytorchdistributed_tpu.utils.hlo import overlap_census
+
+    hlo = """
+HloModule m
+ENTRY e {
+  %p0 = f32[8]{0} parameter(0)
+  %ar = f32[8]{0} all-reduce-start(f32[8]{0} %p0), replica_groups={}
+  %mul = f32[8]{0} multiply(f32[8]{0} %p0, f32[8]{0} %p0)
+  %add = f32[8]{0} add(f32[8]{0} %mul, f32[8]{0} %mul)
+  %d = f32[8]{0} all-reduce-done(f32[8]{0} %ar)
+  %ag.1 = (f32[8]{0}, f32[16]{0}) all-gather-start(f32[8]{0} %d), dimensions={0}
+  %sub = f32[8]{0} subtract(f32[8]{0} %d, f32[8]{0} %d)
+  %g = f32[16]{0} all-gather-done((f32[8]{0}, f32[16]{0}) %ag.1)
+  %cp = f32[8]{0} collective-permute(f32[8]{0} %d), source_target_pairs={{0,1}}
+  ROOT %out = (f32[8]{0}, f32[16]{0}) tuple(f32[8]{0} %cp, f32[16]{0} %g)
+}
+"""
+    c = overlap_census(hlo)
+    assert c["async_pairs"]["all-reduce"] == 1
+    assert c["async_pairs"]["all-gather"] == 1
+    assert c["unpaired_starts"] == 0
+    assert c["overlapped_ops"] == 3      # mul + add, then sub
+    assert c["ppermute"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite units: ring_schedule / all_to_all validation / prefetch depth
+# ---------------------------------------------------------------------------
+
+
+def test_ring_schedule():
+    assert ring_schedule(4, 1) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert ring_schedule(4, -1) == [(0, 3), (1, 0), (2, 1), (3, 2)]
+    assert ring_schedule(4, 5) == ring_schedule(4, 1)
+    assert ring_schedule(3, 0) == [(0, 0), (1, 1), (2, 2)]
+    assert ring_schedule(1, 1) == [(0, 0)]
+    with pytest.raises(ValueError):
+        ring_schedule(0)
+
+
+def test_all_to_all_validates_axes():
+    from pytorchdistributed_tpu.ops.collectives import all_to_all
+
+    x = jnp.ones((4, 8))
+    for bad in (dict(split_axis=2, concat_axis=0),
+                dict(split_axis=0, concat_axis=-1),
+                dict(split_axis="0", concat_axis=1)):
+        with pytest.raises(ValueError, match="out of range"):
+            all_to_all(x, "data", **bad)
+
+
+def test_prefetch_depth_zero_is_synchronous():
+    """Depth 0 must degrade to synchronous transfer: each batch is
+    yielded before the next is pulled from the host iterator (the
+    double-buffer default pulls one ahead)."""
+    from pytorchdistributed_tpu.data.loader import prefetch_to_device
+
+    mesh = create_mesh()
+    from pytorchdistributed_tpu.runtime.mesh import batch_sharding
+
+    sharding = batch_sharding(mesh)
+    pulled = []
+
+    def feed(n):
+        for i in range(n):
+            pulled.append(i)
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    # sync: after pulling k batches the consumer has seen all k
+    it = prefetch_to_device(feed(3), sharding, size=0)
+    for i in range(3):
+        batch = next(it)
+        assert int(batch["x"][0, 0]) == i
+        assert pulled == list(range(i + 1))
+    pulled.clear()
+    # depth 2 runs ahead by up to 2 host batches
+    it = prefetch_to_device(feed(4), sharding, size=2)
+    first = next(it)
+    assert int(first["x"][0, 0]) == 0
+    assert len(pulled) >= 2
+    with pytest.raises(ValueError):
+        list(prefetch_to_device(feed(1), sharding, size=-1))
+
+
+def test_trainer_prefetch_knob(monkeypatch):
+    """Trainer(prefetch=...) and the PTD_PREFETCH env contract resolve
+    in that order, and invalid depths are rejected eagerly."""
+    import optax
+
+    from pytorchdistributed_tpu.models import MLP
+    from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+    def make(**kw):
+        return Trainer(MLP(), optax.sgd(0.1), mse_loss,
+                       mesh=create_mesh(), watchdog=False, **kw)
+
+    assert make().prefetch == 2
+    assert make(prefetch=0).prefetch == 0
+    monkeypatch.setenv("PTD_PREFETCH", "5")
+    assert make().prefetch == 5
+    assert make(prefetch=1).prefetch == 1    # explicit arg wins
+    with pytest.raises(ValueError):
+        make(prefetch=-1)
+    monkeypatch.delenv("PTD_PREFETCH")
+    with pytest.raises(ValueError):
+        make(overlap="maybe")
