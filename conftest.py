@@ -134,6 +134,13 @@ _QUICK = (
     "test_faults.py::test_nan_injection_trips_watchdog",
     "test_faults.py::test_corrupt_latest_checkpoint_falls_back",
     "test_faults.py::test_ckpt_corrupt_injection_and_fallback",
+    # in-graph diagnostics (ISSUE 6): the whole file is quick-tier by
+    # design — units, the sow/collect chain, trainer integration, the
+    # nan-provenance end-to-end drive and the zero-recompile tripwire
+    # all run on test-size models (satellite: regressions trip in
+    # tier-1); plus the HLO byte-identity pin for diagnostics-off
+    "test_diagnostics.py",
+    "test_compiled_invariants.py::test_diag_off_hlo_byte_identical",
 )
 
 

@@ -93,6 +93,12 @@ class ExperimentConfig:
     profile_dir: str = ""          # capture a jax.profiler trace here
     metrics_file: str = ""         # rank-0 JSONL per-step metrics sink
     watchdog: bool = True          # NaN/Inf watchdog at log cadence
+    # In-graph training diagnostics (telemetry/diagnostics.py):
+    # "off" | "scalars" | "full[:N]" — per-layer activation/grad health,
+    # NaN provenance, int8 saturation, all as extra jitted outputs of
+    # the same compiled step. Empty = unset, so the PTD_DIAGNOSTICS env
+    # contract (run.py workers) still applies; any explicit value wins.
+    diagnostics: str = ""
 
 
 # The five BASELINE.json benchmark configs, smallest to largest.
@@ -445,5 +451,6 @@ def make_trainer(cfg: ExperimentConfig):
         metrics_file=cfg.metrics_file or None,
         accum_steps=cfg.accum_steps,
         overlap=cfg.overlap,
+        diagnostics=cfg.diagnostics or None,
     )
     return trainer, loader

@@ -19,8 +19,14 @@ data loader, and the checkpoint save path already call:
   * ``preempt@step=S[,rank=R]`` — the rank SIGTERMs itself: the
     Trainer's preemption handler finishes step S, forces a durable
     checkpoint and exits ``EXIT_PREEMPTED``;
-  * ``nan@step=S[,rank=R]`` — step S's loss is poisoned to NaN so the
-    anomaly tripwire records it and the watchdog raises;
+  * ``nan@step=S[,rank=R][,layer=L]`` — without ``layer``, step S's loss
+    is poisoned to NaN on the host so the anomaly tripwire records it
+    and the watchdog raises; with ``layer=L`` the Trainer instead
+    poisons layer L's params BEFORE step S, so the non-finite values
+    flow through the real compiled model and the in-graph NaN
+    provenance (``diag/first_bad_layer``, telemetry/diagnostics.py —
+    requires ``diagnostics`` on) must pinpoint exactly that layer in
+    the resulting events;
   * ``ckpt_corrupt[@step=S][,rank=R]`` — the first checkpoint committed
     at/after step S has its largest payload file bit-flipped AFTER its
     integrity manifest is written (a torn/corrupted save the verify
@@ -82,6 +88,7 @@ class FaultSpec:
     ms: float = 100.0
     n: int = 0
     code: int = CRASH_EXIT_CODE
+    layer: int | None = None    # nan only: poison THIS layer's params
 
     def describe(self) -> str:
         parts = [self.kind]
@@ -89,6 +96,8 @@ class FaultSpec:
             parts.append(f"step={self.step}")
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
+        if self.layer is not None:
+            parts.append(f"layer={self.layer}")
         return parts[0] + ("@" + ",".join(parts[1:]) if parts[1:] else "")
 
 
@@ -122,7 +131,7 @@ class FaultPlan:
                 key, _, val = item.partition("=")
                 key, val = key.strip(), val.strip()
                 try:
-                    if key in ("step", "rank", "n", "code"):
+                    if key in ("step", "rank", "n", "code", "layer"):
                         kw[key] = int(val)
                     elif key in ("p", "ms"):
                         kw[key] = float(val)
@@ -132,6 +141,9 @@ class FaultPlan:
                     raise ValueError(
                         f"bad fault param {item!r} in {entry!r}: {e}"
                     ) from None
+            if "layer" in kw and kind != "nan":
+                raise ValueError(
+                    f"layer= only applies to nan faults (got {entry!r})")
             if kind in _STEP_KINDS and "step" not in kw:
                 raise ValueError(
                     f"fault {kind!r} needs step= (got {entry!r})")
@@ -242,14 +254,32 @@ class FaultInjector:
     def poison_nan(self, step: int) -> bool:
         """Trainer hook, called AFTER step ``step``: True when this
         step's loss should be replaced with NaN (the tripwire/watchdog
-        pair must record then raise on it)."""
+        pair must record then raise on it). Layer-targeted nan specs
+        take the ``poison_nan_layer`` path instead — never both."""
         for i, spec in enumerate(self.plan.specs):
-            if (spec.kind == "nan" and self._mine(spec)
-                    and spec.step == step
+            if (spec.kind == "nan" and spec.layer is None
+                    and self._mine(spec) and spec.step == step
                     and self._once(f"{i}_nan@{spec.step}")):
                 self._emit(spec, step=step)
                 return True
         return False
+
+    def poison_nan_layer(self, step: int) -> int | None:
+        """Trainer hook, called BEFORE step ``step`` runs: the layer
+        index whose params should be NaN-poisoned this step (the
+        in-graph provenance injection — ISSUE 6), or None. One-shot like
+        every step-targeted fault."""
+        for i, spec in enumerate(self.plan.specs):
+            if (spec.kind == "nan" and spec.layer is not None
+                    and self._mine(spec) and spec.step == step
+                    and self._once(f"{i}_nan@{spec.step}")):
+                self._emit(spec, step=step, layer=spec.layer)
+                sys.stderr.write(
+                    f"[faults] rank {self.rank} injected layer-{spec.layer} "
+                    f"NaN at step {step}\n")
+                sys.stderr.flush()
+                return spec.layer
+        return None
 
     def on_io(self, what: str, *, step: int = -1) -> None:
         """I/O-path hook (data file reads, loader batches, checkpoint

@@ -634,6 +634,32 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
     deterministic: bool = True
 
+    def _sow_diagnostics(self, x):
+        """In-graph block-boundary health stats (ISSUE 6): sow
+        RMS/absmax/non-finite-count of the block OUTPUT — and, under
+        quantized training, the int8 clip fraction of the activations
+        entering the next block's matmuls — into the "diagnostics"
+        collection. Gated entirely on the collection being MUTABLE in
+        this apply (the Trainer's diagnostics knob passes it through the
+        losses): when it isn't, nothing is traced, so a diagnostics-off
+        program is byte-identical HLO to one that predates the knob
+        (pinned by tests/test_compiled_invariants.py). Under nn.scan the
+        sown vectors stack along the layer axis into the [L, 3] table
+        telemetry/diagnostics.py collects."""
+        if self.is_initializing() or not self.is_mutable_collection(
+                "diagnostics"):
+            return
+        from pytorchdistributed_tpu.telemetry.diagnostics import (
+            activation_stat_vec,
+        )
+
+        self.sow("diagnostics", "out_stats", activation_stat_vec(x))
+        if self.cfg.quant != "none":
+            from pytorchdistributed_tpu.ops.quant import saturation_fraction
+
+            self.sow("diagnostics", "int8_sat",
+                     saturation_fraction(x, axis=-1))
+
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
@@ -659,6 +685,7 @@ class TransformerBlock(nn.Module):
         else:
             x = x + attn(norm("ln1", x))
             x = x + ffn(norm("ln2", x))
+        self._sow_diagnostics(x)
         return nn.with_logical_constraint(
             x, (Logical.BATCH, Logical.SEQ, Logical.EMBED))
 
@@ -765,7 +792,8 @@ class TransformerStack(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry), None),
-                variable_axes={"params": 0, "losses": 0, "cache": 0},
+                variable_axes={"params": 0, "losses": 0, "cache": 0,
+                               "diagnostics": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: Logical.STAGE},

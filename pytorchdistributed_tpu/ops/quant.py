@@ -262,6 +262,52 @@ def quantized_dot_general(mode: str):
     return dot_general
 
 
+# ---------------------------------------------------------------------------
+# optional quantization stats (telemetry/diagnostics.py — ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def saturation_fraction(x, axis=-1) -> jax.Array:
+    """Fraction of elements that land on the clip boundary (|q| == 127)
+    after per-channel absmax quantization over ``axis`` — the
+    "int8 saturation" health stat. With scale = absmax/127 the channel
+    maximum quantizes to exactly ±127, so the clean-distribution baseline
+    is ≈ 1/channel_size; a rising fraction means the channel's mass is
+    piling onto its own absmax (outlier-dominated rows losing
+    resolution). OPTIONAL stats output, OFF by default: nothing in the
+    forward/backward dot path calls this — only the diagnostics sow
+    sites do (models/transformer.py, gated on the "diagnostics"
+    collection being mutable) — so the pinned int8 HLO censuses
+    (`int8_ops`) of non-diagnostics programs are untouched."""
+    axis = axis % max(getattr(x, "ndim", 1), 1)
+    scale = absmax_scale(x, (axis,))
+    q = quantize(x, scale).astype(jnp.int32)
+    return jnp.mean((jnp.abs(q) >= int(_QMAX)).astype(jnp.float32))
+
+
+def int8_dot_stats(lhs, rhs, dimension_numbers) -> dict[str, jax.Array]:
+    """Saturation fractions of both operands of a quantized contraction,
+    computed exactly as ``quantized_dot_general`` would quantize them
+    (same per-channel absmax scales, round-to-nearest). A standalone
+    probe for A/B'ing a matmul site's int8 health outside the model —
+    the in-model path sows `saturation_fraction` of the block input
+    instead (one number per layer, the diagnostics table shape)."""
+    (lc, rc), (lb, rb) = dimension_numbers
+    if (tuple(lb), tuple(rb)) != ((), ()):
+        raise NotImplementedError(
+            "int8_dot_stats mirrors quantized_dot_general: contractions "
+            f"without batch dimensions only (got batch dims {(lb, rb)})")
+    ls = absmax_scale(lhs, tuple(lc))
+    rs = absmax_scale(rhs, tuple(rc))
+    ql = quantize(lhs, ls).astype(jnp.int32)
+    qr = quantize(rhs, rs).astype(jnp.int32)
+    lim = int(_QMAX)
+    return {
+        "lhs_sat_frac": jnp.mean((jnp.abs(ql) >= lim).astype(jnp.float32)),
+        "rhs_sat_frac": jnp.mean((jnp.abs(qr) >= lim).astype(jnp.float32)),
+    }
+
+
 def dot_general_for(quant: str):
     """Config-level selector: ``None`` for "none" (callers fall back to
     ``lax.dot_general``), else the shared injectable for the mode. The one

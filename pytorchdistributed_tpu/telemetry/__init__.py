@@ -1,11 +1,16 @@
 """Unified telemetry subsystem (SURVEY.md §5, grown into a layer):
 
-  * spans.py      — host-span tracer (ring buffer → Chrome-trace JSON)
-  * accounting.py — StepAccounting: MFU / tokens-per-s / comm-bytes from
-                    the compiled step joined with wall-clock
-  * events.py     — anomaly tripwires → per-rank TelemetryEvent JSONL
-  * report.py     — the cross-rank run report CLI
-                    (``python -m pytorchdistributed_tpu.telemetry report``)
+  * spans.py       — host-span tracer (ring buffer → Chrome-trace JSON)
+  * accounting.py  — StepAccounting: MFU / tokens-per-s / comm-bytes from
+                     the compiled step joined with wall-clock
+  * events.py      — anomaly tripwires → per-rank TelemetryEvent JSONL
+  * diagnostics.py — in-graph model health (ISSUE 6): per-layer
+                     activation stats, grad/update health, NaN
+                     provenance — extra jitted outputs, zero overhead
+                     when off (``Trainer(diagnostics=...)`` /
+                     PTD_DIAGNOSTICS)
+  * report.py      — the cross-rank run report CLI
+                     (``python -m pytorchdistributed_tpu.telemetry report``)
 
 The Trainer enables all of it with one knob (``telemetry_dir=...`` or the
 launcher's ``--telemetry-dir`` / PTD_TELEMETRY_DIR env).
@@ -20,6 +25,10 @@ from pytorchdistributed_tpu.telemetry.accounting import (  # noqa: F401
     device_memory_highwater,
     ici_bytes_per_s_for,
     peak_flops_for,
+)
+from pytorchdistributed_tpu.telemetry.diagnostics import (  # noqa: F401
+    DIAGNOSTICS_ENV,
+    DiagnosticsConfig,
 )
 from pytorchdistributed_tpu.telemetry.events import (  # noqa: F401
     TELEMETRY_DIR_ENV,

@@ -1,0 +1,316 @@
+"""In-graph training diagnostics (ISSUE 6): per-layer model health as
+extra jitted outputs, not extra dispatches.
+
+The telemetry subsystem (PR 2) and the anomaly tripwires (PR 4) watch the
+training loop from the HOST: a non-finite loss fires an event, but
+nothing durable says *which layer* went non-finite, whether grad norms
+were already drifting ten steps earlier, or whether int8 quantization
+(ops/quant.py) is saturating. This module is the in-graph half:
+
+  * **activation health** — every TransformerBlock sows RMS / absmax /
+    non-finite-count of its output into the "diagnostics" flax
+    collection (models/transformer.py), gated entirely on the collection
+    being *mutable* in the apply — with diagnostics off the stats are
+    never traced and the compiled HLO is byte-identical
+    (tests/test_compiled_invariants.py pins that literally);
+  * **optimizer health** — the train step folds global and
+    per-param-group grad norms, the per-layer grad-norm table of the
+    scanned block stack, and the update/param RMS ratio into the same
+    metrics pytree (training/trainer.py), so steady-state dispatch count
+    is unchanged;
+  * **NaN provenance** — ``diag/first_bad_layer``: the first layer index
+    whose finite-flag drops, computed in-graph from the per-layer
+    non-finite counts; the AnomalyDetector (telemetry/events.py)
+    attaches it to every ``non_finite_metric`` event, so a
+    ``PTD_FAULTS "nan@step=S,layer=L"`` injection (faults/inject.py)
+    is pinpointed end-to-end;
+  * **int8 saturation** — with ``quant != "none"`` the blocks also sow
+    the clip fraction of the activations entering their quantized
+    matmuls (ops/quant.py ``saturation_fraction``).
+
+Key namespace contract (consumed by the Trainer's metric routing):
+``diag/*`` are scalars — they ride the normal log-cadence device sync,
+feed the AnomalyDetector's per-key EMAs, and land in the per-rank
+diagnostics JSONL; ``diag_tbl/*`` are per-layer ``[L]`` arrays — the
+Trainer pops them off the metrics dict on the host (no sync) and writes
+them at the configured table cadence.
+
+Cadence: everything is computed in-graph every step (the stats are a
+handful of reductions — the point of in-graph diagnostics is that the
+cadence knob governs host *emission*, never device work). ``scalars``
+writes scalar rows only; ``full:N`` adds the per-layer tables every ~N
+steps (evaluated at the log-cadence syncs the Trainer already pays for,
+so a table row can be up to ``log_every - 1`` steps later than the
+nominal tick — no extra device blocking is ever added).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+DIAGNOSTICS_ENV = "PTD_DIAGNOSTICS"
+
+# The run-dir file contract (same discipline as events.py's EVENTS_FILE):
+# one diagnostics JSONL per rank, next to the metric log.
+DIAG_FILE = "diagnostics_rank{rank}.jsonl"
+DIAG_GLOB = "diagnostics_rank*.jsonl"
+
+#: flax collection name the model-side sow sites use. Everything is
+#: gated on this collection being mutable in the apply, so the knob
+#: lives entirely at apply time — no model-config flag, no rebuild.
+DIAG_COLLECTION = "diagnostics"
+
+#: metric-key namespaces (see module docstring)
+SCALAR_PREFIX = "diag/"
+TABLE_PREFIX = "diag_tbl/"
+
+_DEFAULT_TABLE_EVERY = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagnosticsConfig:
+    """Parsed diagnostics mode. ``table_every == 0`` means scalar rows
+    only (the per-layer tables are still computed in-graph — provenance
+    needs them — just never written)."""
+
+    table_every: int = 0
+
+    @property
+    def spec(self) -> str:
+        if self.table_every:
+            return f"full:{self.table_every}"
+        return "scalars"
+
+    @classmethod
+    def parse(cls, spec: str) -> "DiagnosticsConfig | None":
+        """``off`` → None; ``scalars`` → scalar rows only; ``full`` /
+        ``full:N`` → per-layer tables every ~N steps (default 50)."""
+        s = str(spec).strip().lower()
+        if s in ("", "off", "none", "0", "false"):
+            return None
+        if s in ("scalars", "on", "1", "true"):
+            return cls(table_every=0)
+        m = re.fullmatch(r"full(?::(\d+))?", s)
+        if m:
+            n = int(m.group(1)) if m.group(1) else _DEFAULT_TABLE_EVERY
+            if n < 1:
+                raise ValueError(
+                    f"diagnostics table cadence must be >= 1, got {spec!r}")
+            return cls(table_every=n)
+        raise ValueError(
+            f"unknown diagnostics mode {spec!r}; one of off | scalars | "
+            f"full[:N] (N = per-layer table cadence in steps)")
+
+    @classmethod
+    def resolve(cls, arg) -> "DiagnosticsConfig | None":
+        """The Trainer-knob resolution order: explicit arg (a spec string
+        or an already-built config) wins, then the PTD_DIAGNOSTICS env
+        contract, then off."""
+        if isinstance(arg, cls):
+            return arg
+        if arg is not None:
+            return cls.parse(arg)
+        return cls.parse(os.environ.get(DIAGNOSTICS_ENV, "off"))
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats (called from the model's sow sites and the train step)
+# ---------------------------------------------------------------------------
+
+#: layout of the per-block sown stat vector (models/transformer.py)
+ACT_STAT_NAMES = ("act_rms", "act_absmax", "act_nonfinite")
+
+
+def activation_stat_vec(x) -> jax.Array:
+    """The ``[3]`` fp32 stat vector one block sows for its output
+    activation: RMS, absmax, and the count of non-finite elements.
+    Non-finite inputs must not poison the first two (NaN absorbs
+    everything): the moments are computed over the finite elements only,
+    so ``act_rms`` stays readable right up to — and after — a blowup
+    while ``act_nonfinite`` carries the event itself."""
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    # count the NON-finite side directly in integer dtype: a float32 sum
+    # of ~2^28 ones rounds (spacing 16 past 2^24) and would erase a
+    # 2-element NaN count on production-size activations — exactly when
+    # provenance matters most
+    nonfinite = jnp.sum(~finite, dtype=jnp.int32)
+    safe = jnp.where(finite, xf, 0.0)
+    denom = jnp.maximum(jnp.int32(x.size) - nonfinite, 1).astype(
+        jnp.float32)
+    rms = jnp.sqrt(jnp.sum(safe * safe) / denom)
+    absmax = jnp.max(jnp.abs(safe))
+    return jnp.stack([rms, absmax, nonfinite.astype(jnp.float32)])
+
+
+def _natural_key(s: str):
+    """Sort 'block_10' after 'block_2' (unrolled stacks name blocks
+    block_0..block_N; lexicographic order would interleave layers)."""
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
+
+
+def collect_activation_tables(coll: Mapping[str, Any]) -> dict[str, Any]:
+    """Sown "diagnostics" collection → ``{stat name: [L] array}``.
+
+    Handles both stacked layouts: under ``nn.scan`` a sow site appears
+    once with a leading layer axis (``out_stats`` → ``[L, 3]``); in an
+    unrolled stack each ``block_i`` sows its own ``[3]`` vector and the
+    layers are reassembled in natural path order. Returns {} when the
+    model sowed nothing (non-transformer models)."""
+    by_name: dict[str, list[tuple[str, Any]]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(dict(coll))[0]:
+        keys = [getattr(k, "key", getattr(k, "idx", k)) for k in path]
+        name = next((str(k) for k in reversed(keys)
+                     if isinstance(k, str)), None)
+        if name is None:
+            continue
+        by_name.setdefault(name, []).append(
+            ("/".join(str(k) for k in keys), leaf))
+
+    out: dict[str, Any] = {}
+
+    def stacked(entries):
+        entries.sort(key=lambda kv: _natural_key(kv[0]))
+        leaves = [v for _, v in entries]
+        if len(leaves) == 1:
+            return leaves[0]
+        return jnp.stack(leaves)
+
+    if "out_stats" in by_name:
+        stats = stacked(by_name["out_stats"])  # [L, 3] (or [3] for L=1)
+        if stats.ndim == 1:
+            stats = stats[None]
+        for i, name in enumerate(ACT_STAT_NAMES):
+            out[name] = stats[:, i]
+    if "int8_sat" in by_name:
+        sat = stacked(by_name["int8_sat"])
+        out["int8_sat"] = sat.reshape(-1)
+    return out
+
+
+def first_bad_layer(act_nonfinite) -> jax.Array:
+    """NaN provenance: the first layer index whose non-finite count is
+    positive, ``-1`` when every layer is clean. Works on the
+    micro-batch-averaged table too (a mean of counts is > 0 iff any
+    micro-batch saw a non-finite element)."""
+    bad = act_nonfinite > 0
+    idx = jnp.argmax(bad)  # first True (argmax of bool picks it)
+    return jnp.where(jnp.any(bad), idx, -1).astype(jnp.float32)
+
+
+def _sumsq_and_size(tree) -> tuple[jax.Array, float]:
+    leaves = [l for l in jax.tree.leaves(tree)
+              if hasattr(l, "dtype")
+              and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.float32(0.0), 0.0
+    ss = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return ss, float(sum(l.size for l in leaves))
+
+
+def tree_norm(tree) -> jax.Array:
+    """Global L2 norm over every floating leaf (optax.global_norm without
+    the integer-leaf trip hazard)."""
+    ss, _ = _sumsq_and_size(tree)
+    return jnp.sqrt(ss)
+
+
+def tree_rms(tree) -> jax.Array:
+    ss, n = _sumsq_and_size(tree)
+    return jnp.sqrt(ss / max(n, 1.0))
+
+
+def _param_groups(tree) -> dict[str, Any]:
+    """Top-level param groups for the per-group norms: unwrap the
+    "params" collection wrapper when present so groups read as the
+    model's own top-level modules (embed / h / ln_f / ...)."""
+    if isinstance(tree, Mapping):
+        inner = tree.get("params", tree)
+        if isinstance(inner, Mapping):
+            return dict(inner)
+    return {}
+
+
+def per_layer_grad_norms(group_tree, num_layers: int) -> jax.Array | None:
+    """``[L]`` per-layer grad norms for a group whose every leaf carries
+    the scanned layer axis in front (the ``nn.scan`` block stack's
+    ``[L, ...]`` leaves). None when the group isn't layer-stacked."""
+    leaves = [l for l in jax.tree.leaves(group_tree)
+              if hasattr(l, "ndim")]
+    if not leaves or num_layers < 2:
+        return None
+    if not all(l.ndim >= 1 and l.shape[0] == num_layers for l in leaves):
+        return None
+    ss = sum(
+        jnp.sum(jnp.square(l.astype(jnp.float32)),
+                axis=tuple(range(1, l.ndim)))
+        for l in leaves)
+    return jnp.sqrt(ss)
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.]+", "_", name)
+
+
+def diagnostics_metrics(*, acts, grads, params, updates,
+                        num_layers: int | None) -> dict[str, Any]:
+    """The full in-graph diagnostics dict for one train step, keyed by
+    the ``diag/`` (scalar) and ``diag_tbl/`` ([L] table) namespaces.
+    Called INSIDE the jitted step — everything here is traced arithmetic
+    on values the step already holds, so it adds zero dispatches.
+
+    ``acts`` is the sown collection (or None when the loss/model doesn't
+    surface one — grad/update health still reports), ``grads``/``params``
+    /``updates`` are the step's trees, ``num_layers`` the transformer
+    depth (None for non-transformer models — disables the per-layer
+    grad table)."""
+    out: dict[str, Any] = {}
+
+    # -- optimizer health --------------------------------------------------
+    out[SCALAR_PREFIX + "grad_norm"] = tree_norm(grads)
+    groups = _param_groups(grads)
+    for name in sorted(groups):
+        out[SCALAR_PREFIX + f"gnorm_{_sanitize(name)}"] = tree_norm(
+            groups[name])
+        if num_layers:
+            layered = per_layer_grad_norms(groups[name], num_layers)
+            if layered is not None:
+                out[TABLE_PREFIX + f"gnorm_{_sanitize(name)}"] = layered
+    # update/param RMS ratio: the effective relative step size — the
+    # quantity LR-schedule debugging actually wants (≈ lr·adam_ratio)
+    out[SCALAR_PREFIX + "update_ratio"] = tree_rms(updates) / jnp.maximum(
+        tree_rms(params), 1e-20)
+
+    # -- activation health -------------------------------------------------
+    if acts:
+        tables = collect_activation_tables(acts)
+        for name, tbl in tables.items():
+            out[TABLE_PREFIX + name] = tbl
+        if "act_rms" in tables:
+            out[SCALAR_PREFIX + "act_rms_mean"] = tables["act_rms"].mean()
+        if "act_absmax" in tables:
+            out[SCALAR_PREFIX + "act_absmax"] = tables["act_absmax"].max()
+        if "act_nonfinite" in tables:
+            out[SCALAR_PREFIX + "act_nonfinite"] = (
+                tables["act_nonfinite"].sum())
+            out[SCALAR_PREFIX + "first_bad_layer"] = first_bad_layer(
+                tables["act_nonfinite"])
+        if "int8_sat" in tables:
+            out[SCALAR_PREFIX + "int8_sat"] = tables["int8_sat"].mean()
+    return out
+
+
+def split_scalars_tables(metrics: Mapping[str, Any]):
+    """(scalars, tables) views of a metrics dict by the diag namespaces —
+    the Trainer's host-side router (pure dict work, no device sync)."""
+    scalars = {k: v for k, v in metrics.items()
+               if k.startswith(SCALAR_PREFIX)}
+    tables = {k: v for k, v in metrics.items()
+              if k.startswith(TABLE_PREFIX)}
+    return scalars, tables

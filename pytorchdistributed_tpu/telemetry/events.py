@@ -12,11 +12,18 @@ incarnation next to its heartbeat state, and the report CLI folds them
 into the run report.
 
 Detectors:
-  * non-finite: any logged metric (loss, grad_norm, ...) NaN/Inf;
-  * loss spike: EMA z-score — an EMA mean/variance of the loss, an
-    event when a new value sits more than ``z_threshold`` deviations
-    above the mean (one-sided: dropping fast is not an anomaly). The
-    EMA warmup suppresses the first noisy observations.
+  * non-finite: any logged metric (loss, grad_norm, ...) NaN/Inf —
+    stamped with the in-graph NaN-provenance layer index
+    (``diag/first_bad_layer``, telemetry/diagnostics.py) when the
+    diagnostics subsystem supplies one;
+  * metric spike: per-key EMA z-score — an independent EMA
+    mean/variance per watched key (the loss, ``grad_norm``, and every
+    ``diag/*`` scalar by default; PTD_ANOMALY_KEYS pins the set,
+    PTD_ANOMALY_Z the threshold), an event when a new value sits more
+    than ``z_threshold`` deviations above the mean (one-sided: dropping
+    fast is not an anomaly). The EMA warmup suppresses the first noisy
+    observations. The loss key keeps its original ``loss_spike`` event
+    shape; other keys emit ``metric_spike``.
 """
 
 from __future__ import annotations
@@ -139,15 +146,48 @@ class EventLog(JsonlWriter):
         return ev
 
 
+# env knobs for the spike tripwires (ISSUE 6a): PTD_ANOMALY_Z overrides
+# the z threshold, PTD_ANOMALY_KEYS (comma list) pins the watched-key set
+# — unset, the detector watches the loss key, grad_norm, and every
+# diag/* scalar the diagnostics subsystem emits.
+ANOMALY_Z_ENV = "PTD_ANOMALY_Z"
+ANOMALY_KEYS_ENV = "PTD_ANOMALY_KEYS"
+
+#: diag scalars that are INDICES/counters, not magnitudes — z-scoring the
+#: provenance layer index jumping -1 → L would only duplicate the
+#: non_finite event that always accompanies it
+_AUTO_WATCH_EXCLUDE = ("diag/first_bad_layer",)
+
+#: metric key whose value (>= 0) names the first non-finite layer — the
+#: in-graph NaN provenance (telemetry/diagnostics.py) the non-finite
+#: events carry so a blowup is pinpointed to its origin layer
+PROVENANCE_KEY = "diag/first_bad_layer"
+
+
 class AnomalyDetector:
     """The tripwire logic, pure host arithmetic on already-synced metric
     floats — `check` adds no device work. Returns (kind, payload) pairs;
-    the caller (Trainer) turns them into EventLog records."""
+    the caller (Trainer) turns them into EventLog records.
 
-    def __init__(self, *, loss_key: str = "loss", z_threshold: float = 6.0,
+    Per-key EMA state (ISSUE 6a): beyond ``loss_key`` the detector keeps
+    an independent EMA mean/variance for every watched key —
+    ``grad_norm`` and any ``diag/*`` scalar by default, or exactly the
+    ``keys``/PTD_ANOMALY_KEYS set when given. Event shapes are
+    backward-compatible: the loss key still emits ``loss_spike`` with the
+    original payload; other keys emit ``metric_spike`` with the same
+    fields plus ``metric``. Non-finite events additionally carry
+    ``first_bad_layer`` whenever the in-graph provenance scalar is
+    present and a layer is implicated."""
+
+    def __init__(self, *, loss_key: str = "loss",
+                 z_threshold: float | None = None,
                  ema: float = 0.98, warmup: int = 5,
-                 min_rel_std: float = 0.05):
+                 min_rel_std: float = 0.05,
+                 keys: tuple[str, ...] | None = None):
         self.loss_key = loss_key
+        if z_threshold is None:
+            env = os.environ.get(ANOMALY_Z_ENV, "").strip()
+            z_threshold = float(env) if env else 6.0
         self.z_threshold = z_threshold
         self.ema = ema
         self.warmup = warmup
@@ -156,37 +196,61 @@ class AnomalyDetector:
         # z-score as a "spike" — only excursions that are also material
         # relative to the loss level should trip
         self.min_rel_std = min_rel_std
-        self._mean = 0.0
-        self._var = 0.0
-        self._seen = 0
+        if keys is None:
+            env = os.environ.get(ANOMALY_KEYS_ENV, "").strip()
+            keys = tuple(k.strip() for k in env.split(",")
+                         if k.strip()) if env else None
+        self._keys = keys  # None = auto (loss + grad_norm + diag/*)
+        # per-key EMA state: key -> [mean, var, seen]
+        self._state: dict[str, list] = {}
+
+    def _watched(self, metrics: dict) -> list[str]:
+        if self._keys is not None:
+            return [k for k in self._keys if k in metrics]
+        return [k for k in metrics
+                if (k == self.loss_key or k == "grad_norm"
+                    or k.startswith("diag/"))
+                and k not in _AUTO_WATCH_EXCLUDE]
 
     def check(self, metrics: dict[str, float],
               step: int) -> list[tuple[str, dict]]:
         out: list[tuple[str, dict]] = []
+        prov = metrics.get(PROVENANCE_KEY)
+        prov = (int(prov) if prov is not None and math.isfinite(float(prov))
+                and float(prov) >= 0 else None)
         for k, v in metrics.items():
             v = float(v)
             if not math.isfinite(v):
-                out.append(("non_finite_metric",
-                            {"metric": k, "value": str(v)}))
-        loss = metrics.get(self.loss_key)
-        if loss is not None and math.isfinite(float(loss)):
-            loss = float(loss)
-            if self._seen >= self.warmup:
-                std = max(math.sqrt(max(self._var, 0.0)),
-                          self.min_rel_std * abs(self._mean), 1e-8)
-                z = (loss - self._mean) / std
+                payload = {"metric": k, "value": str(v)}
+                if prov is not None:
+                    payload["first_bad_layer"] = prov
+                out.append(("non_finite_metric", payload))
+        for key in self._watched(metrics):
+            v = metrics.get(key)
+            if v is None or not math.isfinite(float(v)):
+                continue
+            v = float(v)
+            mean, var, seen = self._state.get(key, (0.0, 0.0, 0))
+            if seen >= self.warmup:
+                std = max(math.sqrt(max(var, 0.0)),
+                          self.min_rel_std * abs(mean), 1e-8)
+                z = (v - mean) / std
                 if z > self.z_threshold:
-                    out.append(("loss_spike", {
-                        "value": round(loss, 6),
-                        "ema_mean": round(self._mean, 6),
-                        "ema_std": round(std, 6), "z": round(z, 2)}))
+                    payload = {"value": round(v, 6),
+                               "ema_mean": round(mean, 6),
+                               "ema_std": round(std, 6), "z": round(z, 2)}
+                    if key == self.loss_key:
+                        out.append(("loss_spike", payload))
+                    else:
+                        out.append(("metric_spike",
+                                    {"metric": key, **payload}))
             # fold AFTER judging: the spike itself must not pre-inflate
             # the variance it is measured against
-            m = self.ema if self._seen else 0.0
-            delta = loss - self._mean
-            self._mean += (1 - m) * delta
-            self._var = m * (self._var + (1 - m) * delta * delta)
-            self._seen += 1
+            m = self.ema if seen else 0.0
+            delta = v - mean
+            mean += (1 - m) * delta
+            var = m * (var + (1 - m) * delta * delta)
+            self._state[key] = [mean, var, seen + 1]
         return out
 
 

@@ -9,6 +9,9 @@ or `run.py --telemetry-dir`) leaves behind in one directory:
     step time, tokens/s, MFU, comm-bytes/step at log cadence);
   * ``spans_rank*.trace.json`` — host-span traces (where host time went);
   * ``events_rank*.jsonl``   — anomaly tripwire events;
+  * ``diagnostics_rank*.jsonl`` — in-graph model-health stream (ISSUE 6:
+    grad-norm groups, update/param ratio, activation health, NaN
+    provenance; per-layer tables at the configured cadence);
   * ``accounting.json``      — the StepAccounting compile-time facts;
   * a `jax.profiler` capture under the dir (``plugins/profile/...``), if
     the run pointed ``profile_dir`` into it — summarized via
@@ -26,6 +29,7 @@ import json
 import os
 
 from pytorchdistributed_tpu.telemetry.accounting import StepAccounting
+from pytorchdistributed_tpu.telemetry.diagnostics import DIAG_GLOB
 from pytorchdistributed_tpu.telemetry.events import (
     METRICS_GLOB,
     read_events,
@@ -46,25 +50,36 @@ def _fmt_bytes(n: float | int | None) -> str:
     return f"{n:.1f} GiB"
 
 
-def _read_metric_rows(run_dir: str) -> dict[int, list[dict]]:
+def _read_rank_rows(run_dir: str, glob_pat: str,
+                    prefix: str) -> dict[int, list[dict]]:
+    """{rank: JSONL rows} for any per-rank ``<prefix><R>.jsonl`` stream
+    (metrics and diagnostics share the exact reader: rank parsed from the
+    filename, torn final lines of a killed rank skipped)."""
     rows: dict[int, list[dict]] = {}
-    for path in sorted(glob.glob(os.path.join(run_dir, METRICS_GLOB))):
+    for path in sorted(glob.glob(os.path.join(run_dir, glob_pat))):
         base = os.path.basename(path)
         try:
-            rank = int(base[len("metrics_rank"):-len(".jsonl")])
+            rank = int(base[len(prefix):-len(".jsonl")])
         except ValueError:
             continue
         out = []
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    try:
-                        out.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        continue  # torn final line
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            out.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue  # torn final line
+        except OSError:
+            continue
         rows[rank] = out
     return rows
+
+
+def _read_metric_rows(run_dir: str) -> dict[int, list[dict]]:
+    return _read_rank_rows(run_dir, METRICS_GLOB, "metrics_rank")
 
 
 def _mean_of(rows: list[dict], key: str) -> float | None:
@@ -118,6 +133,60 @@ def _read_span_totals(run_dir: str) -> dict[int, dict[str, tuple[float, int]]]:
             r[1] += 1
         out[rank] = {k: (v[0], v[1]) for k, v in totals.items()}
     return out
+
+
+def _read_diag_rows(run_dir: str) -> dict[int, list[dict]]:
+    """{rank: rows} from the per-rank diagnostics JSONL (ISSUE 6 —
+    telemetry/diagnostics.py DIAG_FILE contract); empty streams are
+    dropped so the layer-health section can index the last row."""
+    return {rank: rows for rank, rows in _read_rank_rows(
+        run_dir, DIAG_GLOB, "diagnostics_rank").items() if rows}
+
+
+def _layer_health_section(run_dir: str) -> list[str]:
+    """The layer-health table: the LAST per-layer table row each rank's
+    diagnostics stream carries, rendered one line per layer, plus the
+    freshest scalar health summary. Reads rank 0's stream (ranks run the
+    same program; per-rank divergence shows up in the events table)."""
+    rows_by_rank = _read_diag_rows(run_dir)
+    if not rows_by_rank:
+        return ["layer health: no diagnostics stream (run with "
+                "Trainer(diagnostics='scalars'|'full[:N]') or "
+                "PTD_DIAGNOSTICS)"]
+    rank = min(rows_by_rank)
+    rows = rows_by_rank[rank]
+    last = rows[-1]
+    lines = []
+    scalars = {k: v for k, v in last.items()
+               if k.startswith("diag/") and isinstance(v, (int, float))}
+    lines.append(f"diagnostics (rank {rank}, step {last.get('step', '-')}, "
+                 f"{len(rows)} rows):")
+    if scalars:
+        lines.append("  " + "  ".join(
+            f"{k[len('diag/'):]}={v:.4g}" for k, v in sorted(
+                scalars.items())))
+    table_row = next((r for r in reversed(rows) if r.get("layers")), None)
+    if table_row is None:
+        lines.append("  per-layer tables: none written (scalar cadence — "
+                     "use diagnostics='full[:N]')")
+        return lines
+    layers = table_row["layers"]
+    cols = sorted(layers)
+    n = max(len(v) for v in layers.values())
+    lines.append(f"  layer health (step {table_row.get('step', '-')}):")
+    lines.append("    " + f"{'layer':>5}  " + "  ".join(
+        f"{c:>14}" for c in cols))
+    for i in range(n):
+        cells = []
+        for c in cols:
+            v = layers[c]
+            cells.append(f"{v[i]:>14.6g}" if i < len(v) else f"{'-':>14}")
+        marker = ""
+        nf = layers.get("act_nonfinite")
+        if nf and i < len(nf) and nf[i] > 0:
+            marker = "  <- non-finite"
+        lines.append("    " + f"{i:>5}  " + "  ".join(cells) + marker)
+    return lines
 
 
 def _device_trace_section(run_dir: str, top: int) -> list[str]:
@@ -233,6 +302,10 @@ def render(run_dir: str | os.PathLike, *, top: int = 10) -> str:
             lines.append(f"  ... and {len(events) - 50} more")
     else:
         lines.append("tripwire events: none")
+    lines.append("")
+
+    # -- layer health (in-graph diagnostics) --------------------------------
+    lines.extend(_layer_health_section(run_dir))
     lines.append("")
 
     # -- host spans ----------------------------------------------------------

@@ -68,6 +68,33 @@ def _token_loss_reduce(ce, batch):
     return loss, {"_mask_count": count}
 
 
+def _apply_collecting(model, params, *args, diagnostics=False,
+                      mutable=(), **kwargs):
+    """``model.apply`` that optionally opens the "diagnostics" collection
+    (the in-graph health stats the transformer blocks sow — ISSUE 6) on
+    top of whatever mutable collections the loss already needs. Returns
+    ``(output, mods)`` where ``mods`` is {} when nothing was mutable, so
+    call sites stay one-shape. The Trainer requests ``diagnostics=True``
+    only when its diagnostics knob is on AND the loss advertises the
+    kwarg — losses without it keep their exact pre-ISSUE-6 signature and
+    traced program."""
+    cols = list(mutable)
+    if diagnostics:
+        cols.append("diagnostics")
+    if cols:
+        return model.apply(params, *args, mutable=cols, **kwargs)
+    return model.apply(params, *args, **kwargs), {}
+
+
+def _diag_extras(mods, diagnostics):
+    """The "_diag_acts" plumbing key (trainer-bound, never logged): the
+    raw sown collection the train step hands to
+    telemetry.diagnostics.diagnostics_metrics."""
+    if not diagnostics:
+        return {}
+    return {"_diag_acts": dict(mods.get("diagnostics", {}))}
+
+
 def _stochastic_kwargs(target, rng):
     """(kwargs for model.apply) selecting train-mode behavior when ``rng``
     is set: only for methods that take ``deterministic``. That flag now
@@ -92,7 +119,8 @@ def mse_loss(model, params, batch, rng=None):
     return loss, {"loss": loss}
 
 
-def cross_entropy_loss(model, params, batch, rng=None):
+def cross_entropy_loss(model, params, batch, rng=None, *,
+                       diagnostics=False):
     """Image classification: batch = {image, label}. When training (rng
     set), models carrying normalization EMA state (ResNet's "batch_stats")
     refresh it; the updated collection rides the metrics under
@@ -101,34 +129,38 @@ def cross_entropy_loss(model, params, batch, rng=None):
     kwargs = _stochastic_kwargs(type(model).__call__, rng)
     mutable = (["batch_stats"]
                if rng is not None and "batch_stats" in params else [])
-    if mutable:
-        logits, mods = model.apply(params, batch["image"], mutable=mutable,
-                                   **kwargs)
-    else:
-        logits = model.apply(params, batch["image"], **kwargs)
+    logits, mods = _apply_collecting(model, params, batch["image"],
+                                     diagnostics=diagnostics,
+                                     mutable=mutable, **kwargs)
     w = _sample_weight(batch)
     loss = _weighted_scalar(
         optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), batch["label"]), w)
     acc = _weighted_scalar(logits.argmax(-1) == batch["label"], w)
-    metrics = {"loss": loss, "accuracy": acc}
+    metrics = {"loss": loss, "accuracy": acc,
+               **_diag_extras(mods, diagnostics)}
     if mutable:
-        metrics["_collections"] = mods
+        metrics["_collections"] = {k: v for k, v in mods.items()
+                                   if k != "diagnostics"}
     return loss, metrics
 
 
-def token_cross_entropy_loss(model, params, batch, rng=None):
+def token_cross_entropy_loss(model, params, batch, rng=None, *,
+                             diagnostics=False):
     """LM: batch = {tokens, targets}; optional {loss_mask} for MLM."""
-    logits = model.apply(params, batch["tokens"],
-                         **_stochastic_kwargs(type(model).__call__, rng))
+    logits, mods = _apply_collecting(
+        model, params, batch["tokens"], diagnostics=diagnostics,
+        **_stochastic_kwargs(type(model).__call__, rng))
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"]
     )
     loss, extras = _token_loss_reduce(ce, batch)
-    return loss, {"loss": loss, **extras}
+    return loss, {"loss": loss, **extras,
+                  **_diag_extras(mods, diagnostics)}
 
 
-def fused_token_cross_entropy_loss(model, params, batch, rng=None):
+def fused_token_cross_entropy_loss(model, params, batch, rng=None, *,
+                                   diagnostics=False):
     """`token_cross_entropy_loss` through the model's fused chunked-CE head
     (GPT2/Llama `loss_per_position`): the LM head never materializes the
     fp32 ``[batch, seq, vocab]`` logits — ops/fused_ce.py measured the head
@@ -136,18 +168,21 @@ def fused_token_cross_entropy_loss(model, params, batch, rng=None):
     contract and the same math (logsumexp CE in fp32) as the unfused loss;
     use for DP/FSDP training of LM models that define `loss_per_position`.
     """
-    ce = model.apply(params, batch["tokens"], batch["targets"],
-                     method=type(model).loss_per_position,
-                     **_stochastic_kwargs(type(model).loss_per_position,
-                                          rng))
+    ce, mods = _apply_collecting(
+        model, params, batch["tokens"], batch["targets"],
+        diagnostics=diagnostics,
+        method=type(model).loss_per_position,
+        **_stochastic_kwargs(type(model).loss_per_position, rng))
     loss, extras = _token_loss_reduce(ce, batch)
-    return loss, {"loss": loss, **extras}
+    return loss, {"loss": loss, **extras,
+                  **_diag_extras(mods, diagnostics)}
 
 
 MOE_AUX_WEIGHT = 0.01  # Switch Transformer's load-balance coefficient
 
 
-def moe_token_cross_entropy_loss(model, params, batch, rng=None):
+def moe_token_cross_entropy_loss(model, params, batch, rng=None, *,
+                                 diagnostics=False):
     """`token_cross_entropy_loss` (same {tokens, targets, loss_mask?}
     contract) + the Switch load-balance auxiliary loss sown by models/moe.py
     (collection "losses"). The aux term (mean over layers, weight
@@ -155,8 +190,10 @@ def moe_token_cross_entropy_loss(model, params, batch, rng=None):
     without it top-1 routing collapses onto one expert."""
     import jax
 
-    logits, mods = model.apply(params, batch["tokens"], mutable=["losses"],
-                               **_stochastic_kwargs(type(model).__call__, rng))
+    logits, mods = _apply_collecting(
+        model, params, batch["tokens"], diagnostics=diagnostics,
+        mutable=["losses"],
+        **_stochastic_kwargs(type(model).__call__, rng))
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"])
     ce, extras = _token_loss_reduce(ce, batch)
@@ -164,4 +201,4 @@ def moe_token_cross_entropy_loss(model, params, batch, rng=None):
     aux = (sum(jnp.mean(v) for v in sown) / max(len(sown), 1)) if sown else 0.0
     loss = ce + MOE_AUX_WEIGHT * aux
     return loss, {"loss": loss, "ce": ce, "moe_aux": jnp.float32(aux),
-                  **extras}
+                  **extras, **_diag_extras(mods, diagnostics)}

@@ -52,6 +52,13 @@ from pytorchdistributed_tpu.telemetry import (
     SpanTracer,
     device_memory_highwater,
 )
+from pytorchdistributed_tpu.telemetry.diagnostics import (
+    DiagnosticsConfig,
+    split_scalars_tables,
+)
+from pytorchdistributed_tpu.telemetry.diagnostics import (
+    DIAG_FILE as DIAGNOSTICS_FILE,
+)
 from pytorchdistributed_tpu.telemetry.events import (
     EVENT_PREEMPTED,
     EVENTS_FILE,
@@ -193,6 +200,12 @@ class Trainer:
     phases, per-rank metric JSONL with MFU/comm-bytes from StepAccounting,
     and anomaly-tripwire events — read it all back with
     ``python -m pytorchdistributed_tpu.telemetry report <dir>``.
+    ``diagnostics`` (or PTD_DIAGNOSTICS; "off" | "scalars" | "full[:N]")
+    adds in-graph model health to the same compiled step — per-layer
+    activation stats, grad-norm groups/tables, update/param ratio and
+    NaN provenance (telemetry/diagnostics.py) — streamed to a per-rank
+    diagnostics JSONL next to the metric log; off costs literally
+    nothing (byte-identical HLO).
     """
 
     def __init__(
@@ -217,6 +230,7 @@ class Trainer:
         telemetry_dir: str | None = None,
         overlap: str = "xla",
         prefetch: int | None = None,
+        diagnostics: str | DiagnosticsConfig | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -241,6 +255,19 @@ class Trainer:
         if prefetch < 0:
             raise ValueError(f"prefetch depth must be >= 0, got {prefetch}")
         self.prefetch = prefetch
+        # In-graph training diagnostics (ISSUE 6, telemetry/diagnostics.py):
+        # explicit arg wins ("off" | "scalars" | "full[:N]"), then the
+        # PTD_DIAGNOSTICS env contract, then off. On: the train step
+        # additionally returns per-layer activation health, grad-norm
+        # groups/tables, the update/param RMS ratio and the NaN-provenance
+        # scalar — all as extra jitted OUTPUTS of the same compiled step
+        # (zero extra dispatches). Off: not one op is added — the compiled
+        # HLO is byte-identical (pinned in test_compiled_invariants.py).
+        self._diag = DiagnosticsConfig.resolve(diagnostics)
+        self._diag_writer = None
+        self._pending_diag_tables: dict = {}
+        self._diag_table_next = (self._diag.table_every
+                                 if self._diag is not None else 0)
         # User options MERGE OVER the backend defaults — a caller tuning an
         # unrelated flag must not silently drop the scoped-VMEM fix (to
         # override a default, set its key explicitly, e.g.
@@ -297,6 +324,12 @@ class Trainer:
             self._anomaly = AnomalyDetector()
             self._telemetry_jsonl = JsonlWriter(
                 self.telemetry_dir / METRICS_FILE.format(rank=rank))
+            if self._diag is not None:
+                # per-rank diagnostics JSONL next to the metric log —
+                # scalar rows at log cadence, per-layer tables at the
+                # configured cadence (diagnostics.py DIAG_FILE contract)
+                self._diag_writer = JsonlWriter(
+                    self.telemetry_dir / DIAGNOSTICS_FILE.format(rank=rank))
         self._dispatch_shapes: set = set()
         self._accounting_attempted = False
         self._last_batch_samples = 0
@@ -427,6 +460,8 @@ class Trainer:
             / SPAN_TRACE_FILE.format(rank=self._telemetry_rank))
         self._events.close()
         self._telemetry_jsonl.close()
+        if self._diag_writer is not None:
+            self._diag_writer.close()
 
     def lower_step(self, sample_batch, seed: int = 0):
         """AOT-lower the jitted train step from ABSTRACT state: no params
@@ -519,6 +554,23 @@ class Trainer:
             return self._build_1f1b_step()
         policy = self.precision
         loss_fn = self._loss_fn
+        diag = self._diag
+        diag_layers = getattr(cfg, "num_layers", None)
+        if diag is not None:
+            # activation-health collection rides the loss only when the
+            # loss advertises the kwarg (all built-ins do); a custom loss
+            # without it still gets grad/update health — the trainer-side
+            # half needs nothing from the loss
+            import inspect
+
+            if "diagnostics" in inspect.signature(loss_fn).parameters:
+                loss_fn = partial(loss_fn, diagnostics=True)
+            elif dist.is_main_process():
+                self.logger.info(
+                    "diagnostics: loss_fn "
+                    f"{getattr(self._loss_fn, '__name__', self._loss_fn)!r} "
+                    "takes no diagnostics= kwarg — per-layer activation "
+                    "stats are off; grad/update health still reports")
         if self.remat:
             loss_fn = jax.checkpoint(loss_fn, static_argnums=(0,))
 
@@ -545,10 +597,12 @@ class Trainer:
                                             mb_rng)
                 return loss.astype(jnp.float32), metrics
 
+            diag_acts = None
             if accum == 1:
                 (_, metrics), grads = jax.value_and_grad(
                     compute_loss, has_aux=True
                 )(trainable, batch, rng)
+                diag_acts = metrics.pop("_diag_acts", None)
             else:
                 # Gradient accumulation: lax.scan over accum micro-batches
                 # INSIDE the jitted step (one compiled program, activations
@@ -593,6 +647,14 @@ class Trainer:
                     body, (g0, jnp.float32(0.0)), (mbs, jnp.arange(accum)))
                 c_acc = jnp.maximum(c_acc, 1.0)  # all-masked-out batch
                 grads = jax.tree.map(lambda g: g / c_acc, grads)
+                # activation-health tables out BEFORE the metric
+                # reduction: they are [accum, L]-stacked here, and the
+                # token-weighted branch below broadcasts against scalar
+                # metrics only; a plain mean over micro-batches is the
+                # right reduction for diagnostic stats either way
+                diag_acts = metrics.pop("_diag_acts", None)
+                if diag_acts is not None:
+                    diag_acts = jax.tree.map(lambda a: a.mean(0), diag_acts)
                 wts = metrics.pop("_mask_count", None)
                 if wts is None:
                     # plain mean over micro-batches; for "_collections"
@@ -619,6 +681,17 @@ class Trainer:
                 grads, state.opt_state, trainable
             )
             params = optax.apply_updates(trainable, updates)
+            if diag is not None:
+                # in-graph optimizer + activation health (ISSUE 6): a few
+                # reductions over trees the step already holds, folded
+                # into the SAME metrics pytree — dispatch count unchanged
+                from pytorchdistributed_tpu.telemetry.diagnostics import (
+                    diagnostics_metrics,
+                )
+
+                metrics.update(diagnostics_metrics(
+                    acts=diag_acts, grads=grads, params=trainable,
+                    updates=updates, num_layers=diag_layers))
             if new_colls is not None:
                 new_colls = dict(new_colls)
                 new_stats = new_colls.pop("batch_stats", None)
@@ -691,6 +764,14 @@ class Trainer:
                 f"cannot be threaded through the fused schedule — use the "
                 f"built-in token CE losses or pp_schedule='gpipe'")
         parts = self.model.pipeline_parts()
+        if self._diag is not None and dist.is_main_process():
+            # the fused schedule runs the blocks via raw block.apply
+            # inside a shard_map — the sown diagnostics collection cannot
+            # ride it (same reason the loss must be built in)
+            self.logger.info(
+                "diagnostics: pp_schedule='1f1b' runs the fused pipeline "
+                "step — in-graph diagnostics are not collected there "
+                "(use gpipe or a non-pipeline strategy to profile health)")
         if self._loss_fn is cross_entropy_loss and dist.is_main_process():
             # the fused head computes loss only — the sequential path's
             # extra metrics (accuracy) don't ride the pipeline
@@ -781,6 +862,15 @@ class Trainer:
                 name = "compile_and_dispatch"
         with self._span(name), jax.set_mesh(self.mesh):
             self.state, metrics = self._step_fn(self.state, batch)
+        if self._diag is not None:
+            # route the per-layer [L] tables out of the scalar metric
+            # stream on the host (pure dict work — the device arrays are
+            # NOT forced here; they sync only if/when a table row is due)
+            _, tables = split_scalars_tables(metrics)
+            if tables:
+                self._pending_diag_tables = tables
+                metrics = {k: v for k, v in metrics.items()
+                           if k not in tables}
         self._bound_dispatch_queue(metrics)
         return metrics
 
@@ -833,6 +923,13 @@ class Trainer:
                 gstep = epoch * self._steps_per_epoch + i + 1
                 if self._faults is not None:
                     self._faults.on_step(gstep)
+                    # layer-targeted NaN injection (ISSUE 6): poison one
+                    # layer's params so the non-finite values flow through
+                    # the REAL model — the in-graph provenance
+                    # (diag/first_bad_layer) must name exactly this layer
+                    layer = self._faults.poison_nan_layer(gstep)
+                    if layer is not None:
+                        self._poison_layer_params(layer)
                 self._maybe_profile(epoch, i)
                 if self._profiling:
                     # step annotations ride the capture so utils/trace.py
@@ -860,12 +957,27 @@ class Trainer:
                     # the blocking device sync: float() forces the chain
                     with self._span("metric_sync"):
                         vals = {k: float(v) for k, v in metrics.items()}
+                    # diag/* scalars split out of the primary stream:
+                    # they feed the tripwires and the diagnostics JSONL,
+                    # not the console logger / telemetry metric rows
+                    dvals = {}
+                    if self._diag is not None:
+                        dvals = {k: vals.pop(k) for k in list(vals)
+                                 if k.startswith("diag/")}
                     if self._heartbeat is not None:  # we just synced
                         self._heartbeat.beat()
                     # tripwires BEFORE the watchdog: the watchdog RAISES
                     # on the same non-finite values — the durable event
-                    # record must exist by then
-                    self._check_tripwires(epoch, i + 1, vals)
+                    # record must exist by then. The detector sees the
+                    # merged view (per-key EMAs watch diag/* scalars and
+                    # the non-finite event picks up the NaN-provenance
+                    # layer index); the watchdog sees only the primary
+                    # metrics — a non-finite DIAGNOSTIC (e.g. an inf
+                    # absmax one layer deep) is an early warning to
+                    # record, never a reason to abort before the loss
+                    # itself goes bad.
+                    self._check_tripwires(epoch, i + 1, {**vals, **dvals})
+                    self._write_diagnostics(epoch, i + 1, gstep, dvals)
                     if self._watchdog is not None:
                         self._watchdog.check(vals, self.state)
                     rate = self._meter.rate
@@ -944,6 +1056,78 @@ class Trainer:
         hw = device_memory_highwater()
         if hw is not None:
             vals["device_peak_mem_bytes"] = hw
+
+    def _write_diagnostics(self, epoch: int, step: int, gstep: int,
+                           dvals: dict) -> None:
+        """Stream the diagnostics JSONL (telemetry_dir must be set):
+        scalar rows at log cadence; the per-layer tables join a row
+        whenever the table cadence has elapsed — the tables were computed
+        in-graph with the step, so attaching them here costs one host
+        conversion of already-materialized device arrays, never an extra
+        dispatch."""
+        if self._diag_writer is None or not (dvals
+                                             or self._pending_diag_tables):
+            return
+        row = {"time": round(time.time(), 3), "epoch": epoch, "step": step,
+               "rank": self._telemetry_rank,
+               **{k: round(v, 8) for k, v in dvals.items()}}
+        if (self._diag.table_every and self._pending_diag_tables
+                and gstep >= self._diag_table_next):
+            self._diag_table_next = gstep + self._diag.table_every
+            row["layers"] = {
+                k.split("/", 1)[1]:
+                    [round(float(x), 6) for x in np.asarray(v).ravel()]
+                for k, v in self._pending_diag_tables.items()}
+        self._diag_writer.write(row)
+
+    def _poison_layer_params(self, layer: int) -> None:
+        """Fault hook (PTD_FAULTS ``nan@step=S,layer=L``): overwrite one
+        param leaf's slice for ``layer`` with NaN so the blowup originates
+        at that block and propagates forward like a real numeric failure.
+        Scanned stacks are matched by the leading layer axis; unrolled
+        stacks by their ``block_{layer}`` name. The replacement is built
+        under the leaf's own sharding so the donated-state step's
+        in_shardings contract is untouched."""
+        cfg = self._transformer_cfg()
+        nl = getattr(cfg, "num_layers", 0)
+        if not 0 <= layer < max(nl, 1):
+            raise ValueError(
+                f"nan fault layer={layer} out of range for a model with "
+                f"{nl} layers")
+        # The layout question is answered by the CONFIG, never by shape
+        # sniffing: an unrolled block's own leaves can carry a leading dim
+        # equal to num_layers by coincidence (the fused-qkv [3, width]
+        # bias at num_layers=3), which would poison the wrong layer and
+        # silently break the provenance contract.
+        scanned = bool(getattr(cfg, "scan_layers", False))
+        done = [False]
+
+        def pick(path, p, sh):
+            if done[0] or not hasattr(p, "ndim"):
+                return p
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            key = jax.tree_util.keystr(path)
+            if scanned:
+                if not ("block" in key and p.ndim >= 1
+                        and p.shape[0] == nl):
+                    return p
+                fn = lambda x: x.at[layer].set(jnp.nan)  # noqa: E731
+            else:
+                if f"block_{layer}'" not in key:
+                    return p
+                fn = lambda x: jnp.full_like(x, jnp.nan)  # noqa: E731
+            done[0] = True
+            return jax.jit(fn, out_shardings=sh)(p)
+
+        params = jax.tree_util.tree_map_with_path(
+            pick, self.state.params, self.state_shardings.params)
+        if not done[0]:
+            raise ValueError(
+                "nan fault layer targeting found no block param leaf to "
+                "poison (non-transformer model?) — drop layer= to use the "
+                "host-side loss poisoning instead")
+        self.state = self.state.replace(params=params)
 
     # -- evaluation --------------------------------------------------------
 
@@ -1377,11 +1561,15 @@ class Trainer:
 
 
 def _drop_sown(variables):
-    """Strip the "losses" collection a `model.init` may have sown (Switch-MoE
-    aux values): it is per-batch OUTPUT, not state — keeping it in
-    TrainState would allocate optimizer slots for it and break the 1F1B
-    grad merge (pipeline_parts grads cover "params" only)."""
-    return {k: v for k, v in variables.items() if k != "losses"}
+    """Strip the sown per-batch OUTPUT collections a `model.init` may have
+    created ("losses" — Switch-MoE aux values; "diagnostics" — the
+    in-graph health stats, which the block sow sites already skip at init
+    but are dropped here too for defense in depth): they are not state —
+    keeping them in TrainState would allocate optimizer slots for them
+    and break the 1F1B grad merge (pipeline_parts grads cover "params"
+    only)."""
+    return {k: v for k, v in variables.items()
+            if k not in ("losses", "diagnostics")}
 
 
 def _opt_state_shardings(abstract_opt_state, abstract_params, param_shardings,

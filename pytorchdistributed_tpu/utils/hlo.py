@@ -241,6 +241,17 @@ def int8_counts(hlo_text: str) -> dict[str, int]:
     }
 
 
+def hlo_fingerprint(compiled) -> str:
+    """sha256 of the executable's optimized-HLO text — the byte-identity
+    tripwire (ISSUE 6): two compiles whose fingerprints match ran the
+    same program, to the byte. Used to prove the diagnostics knob's OFF
+    path adds literally nothing to a train step (the committed numeric
+    invariants bound drift; this bounds it to zero)."""
+    import hashlib
+
+    return hashlib.sha256(compiled.as_text().encode()).hexdigest()
+
+
 def compiled_invariants(compiled) -> dict:
     """The committed-invariant dict for one compiled train step.
 

@@ -583,6 +583,48 @@ def test_flagship_invariants(name):
     _check(name)
 
 
+def test_diag_off_hlo_byte_identical(monkeypatch):
+    """ISSUE 6 acceptance, wired into the capture_invariants flow: with
+    diagnostics DISABLED, the compiled train step must be byte-identical
+    to the pre-knob program — not "equal invariants", the same HLO text
+    to the byte (the committed numeric pins above bound drift vs the
+    pre-PR captures; this bounds the off-path's contribution to exactly
+    zero). Covers all three off spellings (default, explicit "off",
+    env "off") and sanity-checks that turning diagnostics ON does change
+    the program — a knob whose on-path is invisible would mean the sow
+    sites silently stopped collecting."""
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        token_cross_entropy_loss,
+    )
+    from pytorchdistributed_tpu.utils.hlo import hlo_fingerprint
+
+    batch = _lm_batch(32, 64)
+
+    def fingerprint(diagnostics):
+        tr = Trainer(GPT2(gpt2_config("test")), optax.adamw(3e-4),
+                     token_cross_entropy_loss, mesh=create_mesh(data=8),
+                     strategy="dp", log_every=10**9,
+                     diagnostics=diagnostics)
+        return hlo_fingerprint(tr.lower_step(batch).compile())
+
+    monkeypatch.delenv("PTD_DIAGNOSTICS", raising=False)
+    base = fingerprint(None)
+    assert fingerprint("off") == base, (
+        "Trainer(diagnostics='off') compiled a DIFFERENT program than the "
+        "default — the off path must add nothing")
+    monkeypatch.setenv("PTD_DIAGNOSTICS", "scalars")
+    assert fingerprint("off") == base, (
+        "explicit diagnostics='off' must beat the PTD_DIAGNOSTICS env")
+    assert fingerprint(None) != base, (
+        "PTD_DIAGNOSTICS=scalars left the program unchanged — the "
+        "diagnostics sow/step sites are not collecting")
+
+
 DECODE_COMMITTED: dict = {
     "flops": 226509897728.0,
     "temp_bytes": 666758832,

@@ -1,0 +1,419 @@
+"""In-graph training diagnostics (ISSUE 6, telemetry/diagnostics.py).
+
+Covers the whole chain: the per-layer stats the transformer blocks sow,
+the grad/update health the train step folds into its metrics pytree, the
+NaN-provenance scalar and its end-to-end ride — a PTD_FAULTS
+``nan@step=S,layer=L`` injection must produce anomaly events naming
+exactly layer L — plus the zero-overhead disciplines: diagnostics (any
+cadence) add zero steady-state recompiles, and with diagnostics off not
+one metric key or JSONL file appears (the byte-identical-HLO half lives
+in test_compiled_invariants.py::test_diag_off_hlo_byte_identical).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.ops.quant import (
+    int8_dot_stats,
+    saturation_fraction,
+)
+from pytorchdistributed_tpu.runtime.mesh import create_mesh
+from pytorchdistributed_tpu.telemetry.diagnostics import (
+    DiagnosticsConfig,
+    activation_stat_vec,
+    collect_activation_tables,
+    first_bad_layer,
+)
+from pytorchdistributed_tpu.telemetry.events import AnomalyDetector
+from pytorchdistributed_tpu.training import Trainer, token_cross_entropy_loss
+
+NUM_LAYERS = 4
+
+
+def _batch(seed=0, batch=32, seq=64):
+    rng = np.random.default_rng(seed)
+    return {
+        "tokens": rng.integers(0, 128, (batch, seq)).astype(np.int32),
+        "targets": rng.integers(0, 128, (batch, seq)).astype(np.int32),
+    }
+
+
+def _trainer(diagnostics=None, *, telemetry_dir=None, log_every=1,
+             cfg_kw=None, **kw):
+    model = GPT2(gpt2_config("test",
+                             **{"num_layers": NUM_LAYERS, **(cfg_kw or {})}))
+    return Trainer(model, optax.adamw(1e-3), token_cross_entropy_loss,
+                   mesh=create_mesh(data=8), strategy="dp",
+                   log_every=log_every, diagnostics=diagnostics,
+                   telemetry_dir=(str(telemetry_dir) if telemetry_dir
+                                  else None), **kw)
+
+
+class _FakeLoader:
+    batch_size = 32
+
+    def __init__(self, n=4, seed=0):
+        self._batches = [_batch(seed + i) for i in range(n)]
+
+    def set_epoch(self, epoch):
+        pass
+
+    def __len__(self):
+        return len(self._batches)
+
+    def __iter__(self):
+        return iter([dict(b) for b in self._batches])
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+
+class TestDiagnosticsConfig:
+    def test_parse_modes(self):
+        assert DiagnosticsConfig.parse("off") is None
+        assert DiagnosticsConfig.parse("") is None
+        assert DiagnosticsConfig.parse("scalars") == DiagnosticsConfig(0)
+        assert DiagnosticsConfig.parse("full") == DiagnosticsConfig(50)
+        assert DiagnosticsConfig.parse("full:7") == DiagnosticsConfig(7)
+        assert DiagnosticsConfig.parse("FULL:7").table_every == 7
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="diagnostics mode"):
+            DiagnosticsConfig.parse("verbose")
+        with pytest.raises(ValueError):
+            DiagnosticsConfig.parse("full:0")
+
+    def test_resolve_env_and_explicit(self, monkeypatch):
+        monkeypatch.delenv("PTD_DIAGNOSTICS", raising=False)
+        assert DiagnosticsConfig.resolve(None) is None
+        monkeypatch.setenv("PTD_DIAGNOSTICS", "full:9")
+        assert DiagnosticsConfig.resolve(None) == DiagnosticsConfig(9)
+        # explicit arg wins over env — including explicit "off"
+        assert DiagnosticsConfig.resolve("off") is None
+        assert DiagnosticsConfig.resolve("scalars") == DiagnosticsConfig(0)
+        assert DiagnosticsConfig.resolve(
+            DiagnosticsConfig(3)) == DiagnosticsConfig(3)
+
+
+def test_activation_stat_vec_units():
+    x = jnp.array([[3.0, -4.0], [0.0, 0.0]])
+    rms, absmax, nonfinite = np.asarray(activation_stat_vec(x))
+    assert absmax == 4.0 and nonfinite == 0.0
+    assert rms == pytest.approx(np.sqrt(25.0 / 4.0))
+    # non-finite elements are COUNTED but excluded from the moments —
+    # rms/absmax stay readable through a blowup
+    x = jnp.array([[jnp.nan, jnp.inf], [3.0, -4.0]])
+    rms, absmax, nonfinite = np.asarray(activation_stat_vec(x))
+    assert nonfinite == 2.0 and absmax == 4.0
+    assert rms == pytest.approx(np.sqrt(25.0 / 2.0))
+
+
+def test_first_bad_layer_unit():
+    assert float(first_bad_layer(jnp.array([0.0, 0.0, 0.0]))) == -1.0
+    assert float(first_bad_layer(jnp.array([0.0, 2.0, 5.0]))) == 1.0
+    # micro-batch-averaged counts (fractional) still resolve
+    assert float(first_bad_layer(jnp.array([0.0, 0.0, 0.5]))) == 2.0
+
+
+def test_saturation_fraction_units():
+    # every element equals the channel absmax -> all on the clip boundary
+    assert float(saturation_fraction(jnp.ones((4, 8)))) == pytest.approx(1.0)
+    # one dominant outlier per row -> only it reaches |q| == 127
+    x = jnp.concatenate([jnp.full((4, 1), 1000.0), jnp.ones((4, 7))], -1)
+    assert float(saturation_fraction(x)) == pytest.approx(1 / 8)
+
+
+def test_int8_dot_stats_matches_saturation():
+    rng = np.random.default_rng(0)
+    lhs = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+    stats = int8_dot_stats(lhs, rhs, (((1,), (0,)), ((), ())))
+    assert set(stats) == {"lhs_sat_frac", "rhs_sat_frac"}
+    assert float(stats["lhs_sat_frac"]) == pytest.approx(
+        float(saturation_fraction(lhs, axis=1)))
+    for v in stats.values():
+        assert 0.0 < float(v) <= 1.0
+    with pytest.raises(NotImplementedError):
+        int8_dot_stats(lhs[None], rhs[None],
+                       (((2,), (1,)), ((0,), (0,))))
+
+
+# ---------------------------------------------------------------------------
+# the model-side sow sites
+# ---------------------------------------------------------------------------
+
+
+def _sown_tables(cfg_kw=None):
+    model = GPT2(gpt2_config("test",
+                             **{"num_layers": NUM_LAYERS, **(cfg_kw or {})}))
+    tokens = jnp.asarray(_batch()["tokens"][:4])
+    params = model.init(jax.random.key(0), tokens[:, :8])
+    _, mods = model.apply(params, tokens, mutable=["diagnostics"])
+    return collect_activation_tables(mods["diagnostics"])
+
+
+def test_blocks_sow_per_layer_tables():
+    tables = _sown_tables()
+    assert set(tables) == {"act_rms", "act_absmax", "act_nonfinite"}
+    for name, tbl in tables.items():
+        assert tbl.shape == (NUM_LAYERS,), name
+    assert np.all(np.asarray(tables["act_rms"]) > 0)
+    assert np.all(np.asarray(tables["act_nonfinite"]) == 0)
+
+
+def test_unrolled_stack_sows_in_layer_order():
+    # scan_layers=False names blocks block_0..block_N — the collector must
+    # reassemble them in NATURAL order (block_2 before block_10)
+    tables = _sown_tables(dict(scan_layers=False, num_layers=12))
+    assert tables["act_rms"].shape == (12,)
+
+
+def test_quant_blocks_sow_int8_saturation():
+    tables = _sown_tables(dict(quant="int8_fwd"))
+    assert tables["int8_sat"].shape == (NUM_LAYERS,)
+    sat = np.asarray(tables["int8_sat"])
+    assert np.all((sat > 0) & (sat <= 1.0))
+
+
+def test_no_mutable_collection_sows_nothing():
+    model = GPT2(gpt2_config("test", num_layers=NUM_LAYERS))
+    tokens = jnp.asarray(_batch()["tokens"][:4])
+    variables = model.init(jax.random.key(0), tokens[:, :8])
+    # init must not have created the diagnostics collection (it is
+    # per-batch output, not state)
+    assert set(variables) == {"params"}
+    out = model.apply(variables, tokens)  # plain apply: no tuple, no sow
+    assert out.shape == (4, tokens.shape[1], 128)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyDetector: per-key EMAs, env knobs, provenance (satellite 6a)
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_detector_watches_grad_norm_and_diag():
+    det = AnomalyDetector(warmup=3, z_threshold=6.0)
+    for step in range(6):
+        assert det.check({"loss": 1.0, "grad_norm": 2.0,
+                          "diag/update_ratio": 1e-3}, step=step) == []
+    found = det.check({"loss": 1.0, "grad_norm": 500.0,
+                       "diag/update_ratio": 1e-3}, step=6)
+    assert [k for k, _ in found] == ["metric_spike"]
+    assert found[0][1]["metric"] == "grad_norm"
+    assert found[0][1]["z"] > 6.0
+    # the loss key keeps its ORIGINAL event kind and payload shape
+    found = det.check({"loss": 900.0, "grad_norm": 2.0}, step=7)
+    assert [k for k, _ in found] == ["loss_spike"]
+    assert set(found[0][1]) == {"value", "ema_mean", "ema_std", "z"}
+
+
+def test_anomaly_detector_env_knobs(monkeypatch):
+    monkeypatch.setenv("PTD_ANOMALY_Z", "2.0")
+    monkeypatch.setenv("PTD_ANOMALY_KEYS", "mfu")
+    det = AnomalyDetector(warmup=2)
+    assert det.z_threshold == 2.0
+    for step in range(4):
+        det.check({"mfu": 0.5, "grad_norm": 1.0}, step=step)
+    # grad_norm is NOT watched (keys pinned to mfu); a mild mfu rise
+    # trips at the lowered threshold
+    found = det.check({"mfu": 0.9, "grad_norm": 1e6}, step=5)
+    assert [(k, p["metric"]) for k, p in found] == [("metric_spike", "mfu")]
+
+
+def test_nonfinite_event_carries_provenance():
+    det = AnomalyDetector()
+    found = det.check({"loss": float("nan"),
+                       "diag/first_bad_layer": 2.0}, step=3)
+    nf = [p for k, p in found if k == "non_finite_metric"]
+    assert nf and all(p["first_bad_layer"] == 2 for p in nf)
+    # no provenance scalar (diagnostics off) -> original payload shape
+    found = det.check({"loss": float("nan")}, step=4)
+    payload = dict(found[0][1])
+    assert set(payload) == {"metric", "value"}
+    # clean provenance (-1) is not attached
+    found = det.check({"loss": float("inf"),
+                       "diag/first_bad_layer": -1.0}, step=5)
+    assert "first_bad_layer" not in found[0][1]
+
+
+# ---------------------------------------------------------------------------
+# trainer integration
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_diag_metrics_and_jsonl_stream(tmp_path):
+    tr = _trainer("full:2", telemetry_dir=tmp_path)
+    metrics = tr.run_epoch(_FakeLoader(4), epoch=0)
+    for key in ("diag/grad_norm", "diag/update_ratio", "diag/act_rms_mean",
+                "diag/act_absmax", "diag/first_bad_layer"):
+        assert key in metrics, sorted(metrics)
+    assert metrics["diag/first_bad_layer"] == -1.0
+    assert metrics["diag/grad_norm"] > 0
+    # per-layer tables never leak into the scalar metric stream
+    assert not any(k.startswith("diag_tbl/") for k in metrics)
+    [path] = glob.glob(str(tmp_path / "diagnostics_rank0.jsonl"))
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(rows) == 4  # scalar row per log sync (log_every=1)
+    table_rows = [r for r in rows if "layers" in r]
+    assert table_rows, "full:2 over 4 steps must write layer tables"
+    layers = table_rows[-1]["layers"]
+    assert set(layers) >= {"act_rms", "act_absmax", "act_nonfinite",
+                           "gnorm_h"}
+    assert all(len(v) == NUM_LAYERS for v in layers.values())
+    # the primary telemetry metric rows stay diag-free (separate streams)
+    mrows = [json.loads(l)
+             for l in open(tmp_path / "metrics_rank0.jsonl") if l.strip()]
+    assert mrows and not any(k.startswith("diag")
+                             for r in mrows for k in r)
+
+
+def test_diag_off_adds_no_keys_and_no_stream(tmp_path):
+    tr = _trainer(None, telemetry_dir=tmp_path)
+    metrics = tr.run_epoch(_FakeLoader(2), epoch=0)
+    assert not any(k.startswith("diag") for k in metrics)
+    assert not glob.glob(str(tmp_path / "diagnostics_rank*.jsonl"))
+
+
+def test_diag_composes_with_accum_and_remat(tmp_path):
+    tr = _trainer("scalars", telemetry_dir=tmp_path, accum_steps=2,
+                  remat=True, cfg_kw=dict(remat=True))
+    m = tr.train_step(_batch())
+    m = tr.train_step(_batch(1))
+    assert float(m["diag/grad_norm"]) > 0
+    assert float(m["diag/first_bad_layer"]) == -1.0
+    assert np.isfinite(float(m["diag/update_ratio"]))
+
+
+def test_diag_int8_saturation_rides_quant_step():
+    tr = _trainer("scalars", cfg_kw=dict(quant="int8_fwd"))
+    m = tr.train_step(_batch())
+    assert 0.0 < float(m["diag/int8_sat"]) <= 1.0
+
+
+def test_zero_steadystate_recompiles_with_diagnostics():
+    """Any diagnostics cadence rides ONE compiled step: the cadence is a
+    host-emission knob, never a second program (the pjit _cache_size
+    tripwire, as in test_overlap/test_serving)."""
+    tr = _trainer("full:2")
+    for i in range(5):
+        tr.train_step(_batch(i))
+    assert tr._step_fn._cache_size() == 1
+
+
+def test_nan_provenance_end_to_end(tmp_path, monkeypatch):
+    """ISSUE 6 acceptance: the PR 4 nan fault at a chosen layer produces
+    anomaly events identifying that layer. The injection poisons layer
+    2's params BEFORE the step, the blowup flows through the real
+    compiled model, the in-graph provenance pins it, the tripwire writes
+    it durably, then the watchdog raises."""
+    from pytorchdistributed_tpu.faults.inject import reset_active
+    from pytorchdistributed_tpu.telemetry import read_events
+
+    target = 2
+    run_dir = tmp_path / "run"
+    monkeypatch.setenv("PTD_FAULTS", f"nan@step=3,layer={target}")
+    monkeypatch.setenv("PTD_FAULTS_STATE", str(tmp_path / "state"))
+    monkeypatch.setenv("PTD_TELEMETRY_DIR", str(run_dir))
+    reset_active()
+    try:
+        tr = _trainer("scalars", telemetry_dir=run_dir)
+        with pytest.raises(FloatingPointError):
+            tr.run_epoch(_FakeLoader(5), epoch=0)
+    finally:
+        reset_active()
+    events = read_events(run_dir)
+    fault = [e for e in events if e.kind == "fault_injected"]
+    assert fault and fault[0].data["layer"] == target
+    nonfinite = [e for e in events if e.kind == "non_finite_metric"
+                 and e.data["metric"] == "loss"]
+    assert nonfinite, [e.kind for e in events]
+    assert nonfinite[0].data["first_bad_layer"] == target
+    assert nonfinite[0].step == 3
+
+
+def test_nan_layer_fault_spec_validation():
+    from pytorchdistributed_tpu.faults.inject import FaultPlan
+
+    plan = FaultPlan.parse("nan@step=4,layer=3")
+    assert plan.specs[0].layer == 3
+    assert "layer=3" in plan.specs[0].describe()
+    with pytest.raises(ValueError, match="layer="):
+        FaultPlan.parse("crash@step=4,layer=3")
+
+
+def test_poison_layer_rejects_out_of_range():
+    tr = _trainer("scalars")
+    tr.init(_batch())
+    with pytest.raises(ValueError, match="out of range"):
+        tr._poison_layer_params(NUM_LAYERS)
+
+
+def test_poison_layer_targets_right_block_when_unrolled():
+    """Regression (review finding): at num_layers=3 an unrolled block's
+    OWN fused-qkv bias has leading dim 3 == num_layers — shape sniffing
+    would poison block_0's bias at row `layer` instead of block_2. The
+    layout decision must come from cfg.scan_layers."""
+    target = 2
+    tr = _trainer("scalars", cfg_kw=dict(scan_layers=False, num_layers=3))
+    tr.init(_batch())
+    tr._poison_layer_params(target)
+    blocks = tr.state.params["params"]["h"]
+    for i in range(3):
+        leaves = [np.asarray(l) for l in
+                  jax.tree.leaves(blocks[f"block_{i}"])]
+        has_nan = any(np.isnan(l).any() for l in leaves)
+        assert has_nan == (i == target), (i, has_nan)
+    # and the provenance scalar agrees end to end
+    m = tr.train_step(_batch())
+    assert float(m["diag/first_bad_layer"]) == target
+
+
+def test_custom_loss_without_kwarg_still_gets_grad_health():
+    """A loss that doesn't advertise diagnostics= keeps working: no
+    activation stats, but grad/update health still reports."""
+    def plain_loss(model, params, batch, rng=None):
+        logits = model.apply(params, batch["tokens"])
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), batch["targets"])
+        return ce.mean(), {"loss": ce.mean()}
+
+    model = GPT2(gpt2_config("test", num_layers=NUM_LAYERS))
+    tr = Trainer(model, optax.adamw(1e-3), plain_loss,
+                 mesh=create_mesh(data=8), strategy="dp",
+                 log_every=10**9, diagnostics="scalars")
+    m = tr.train_step(_batch())
+    assert float(m["diag/grad_norm"]) > 0
+    assert "diag/act_rms_mean" not in m
+
+
+def test_report_renders_layer_health(tmp_path):
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    rows = [
+        {"time": 1.0, "epoch": 0, "step": 2, "rank": 0,
+         "diag/grad_norm": 0.5, "diag/first_bad_layer": -1.0},
+        {"time": 2.0, "epoch": 0, "step": 4, "rank": 0,
+         "diag/grad_norm": 0.7, "diag/first_bad_layer": 1.0,
+         "layers": {"act_rms": [1.0, 2.0], "act_absmax": [3.0, 9.0],
+                    "act_nonfinite": [0.0, 8.0]}},
+    ]
+    with open(tmp_path / "diagnostics_rank0.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    out = render(tmp_path)
+    assert "layer health" in out
+    assert "act_rms" in out
+    assert "<- non-finite" in out  # layer 1's nonzero count is flagged
+    # empty run dirs say how to turn the stream on
+    assert "PTD_DIAGNOSTICS" in render(tmp_path / "nothing_here")
