@@ -605,6 +605,130 @@ def bench_generate() -> dict:
             "batch32_tokens_per_s": round(r32, 1)}
 
 
+def _drive_serve_trace(engine, prompts, arrivals, max_new, *,
+                       sampling_cls=None) -> tuple[dict, int]:
+    """Feed a (seeded) arrival trace to an engine in wall-clock time and
+    drain it; returns (engine.summary(), peak concurrently-RESIDENT
+    requests) — the peak is the capacity number the paged-vs-dense A/B
+    compares at a fixed HBM budget."""
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, prompts))
+    peak = 0
+    while (pending or engine.queue_depth or engine.active_count
+           or engine.prefilling_count):
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, p = pending.pop(0)
+            kw = {}
+            if sampling_cls is not None:
+                kw["sampling"] = sampling_cls(temperature=0.8, top_k=40,
+                                              seed=engine.queue_depth)
+            engine.submit(p, max_new_tokens=max_new, **kw)
+        if (engine.queue_depth or engine.active_count
+                or engine.prefilling_count):
+            engine.step()
+            peak = max(peak, engine.active_count)
+        elif pending:
+            time.sleep(min(0.01, max(0.0, pending[0][0] - now)))
+    return engine.summary(), peak
+
+
+def _serve_capacity_ab(block_size: int) -> dict:
+    """The ISSUE 7 capacity claim, measured: a dense engine and a paged
+    engine at the SAME KV-HBM budget (pool bytes == dense cache bytes,
+    via inference.kv_cache_bytes on both) serve the same mixed-length
+    Poisson trace; the paged engine's slot count is oversubscribed 4x,
+    and because HBM now bounds actual resident tokens instead of
+    slots x max_seq_len, its peak resident count should run >= 2x the
+    dense engine's."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import ServingEngine
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=512,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    dense_slots = 4
+    pages = cfg.max_seq_len // block_size
+    rng = np.random.default_rng(7)
+    n = 24
+    lens = rng.integers(16, 97, n)
+    prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+               for m in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / 64.0, n))  # near-burst
+
+    dense = ServingEngine(model, params, num_slots=dense_slots,
+                          prefill_bucket=128)
+    dense.warmup(prompt_lens=(128,))
+    d_sum, d_peak = _drive_serve_trace(dense, prompts, arrivals, 24)
+    dense.close()
+
+    paged = ServingEngine(model, params, num_slots=4 * dense_slots,
+                          prefill_bucket=128, block_size=block_size,
+                          num_blocks=dense_slots * pages)  # same HBM
+    paged.warmup(prompt_lens=(128,))
+    p_sum, p_peak = _drive_serve_trace(paged, prompts, arrivals, 24)
+    paged.close()
+
+    return {
+        "kv_hbm_bytes_dense": d_sum["kv_hbm_bytes"],
+        "kv_hbm_bytes_paged": p_sum["kv_hbm_bytes"],
+        "dense_peak_resident": d_peak,
+        "paged_peak_resident": p_peak,
+        "resident_ratio": round(p_peak / max(1, d_peak), 2),
+        "paged_block_utilization": p_sum["block_utilization"],
+        "paged_preemptions": p_sum["preemptions"],
+        "dense_ttft_ms_p50": d_sum.get("ttft_ms_p50"),
+        "paged_ttft_ms_p50": p_sum.get("ttft_ms_p50"),
+    }
+
+
+def _serve_prefix_ab(block_size: int) -> dict:
+    """The ISSUE 7 TTFT claim, measured: a shared-system-prompt trace
+    (the chat-frontend shape) served by the paged engine with the radix
+    prefix cache ON vs OFF. With reuse, every admission after the first
+    skips the shared blocks' prefill compute — prefix_hit_rate > 0 and a
+    lower TTFT p50 than the no-reuse twin."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import ServingEngine
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=512,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(11)
+    system = rng.integers(0, cfg.vocab_size, (256,)).astype(np.int32)
+    prompts = [np.concatenate([
+        system, rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)])
+        for _ in range(12)]
+    arrivals = np.cumsum(rng.exponential(1.0 / 64.0, len(prompts)))
+
+    out = {}
+    for name, reuse in (("prefix_on", True), ("prefix_off", False)):
+        engine = ServingEngine(model, params, num_slots=4,
+                               prefill_bucket=128, block_size=block_size,
+                               prefill_chunk=128, prefix_cache=reuse)
+        engine.warmup(prompt_lens=(128,))
+        s, _ = _drive_serve_trace(engine, prompts, arrivals, 8)
+        engine.close()
+        out[name] = {"ttft_ms_p50": s.get("ttft_ms_p50"),
+                     "prefix_hit_rate": s.get("prefix_hit_rate"),
+                     "prefill_chunks": s.get("prefill_chunks")}
+    on, off = out["prefix_on"], out["prefix_off"]
+    if on["ttft_ms_p50"] and off["ttft_ms_p50"]:
+        out["ttft_p50_speedup"] = round(
+            off["ttft_ms_p50"] / on["ttft_ms_p50"], 3)
+    return out
+
+
 def bench_serve() -> dict:
     """Continuous-batching serving (serving/ServingEngine) under a
     synthetic Poisson arrival trace: seeded exponential inter-arrivals at
@@ -615,9 +739,16 @@ def bench_serve() -> dict:
     ``slot_occupancy`` — the same numbers the engine's telemetry bridge
     emits. Warmup compiles every prefill bucket + the tick before the
     clock starts; the record asserts-by-stamping ``recompiles`` (must be
-    0 — the zero-retrace guarantee under load). Runs on CPU-sim or TPU
-    unchanged; knobs via env: PTD_SERVE_SIZE/SLOTS/REQUESTS/RATE/
-    MAX_NEW, PTD_QUANT rides the model config like the training benches."""
+    0 — the zero-retrace guarantee under load). PTD_SERVE_PAGED=1 runs
+    the main trace on the PAGED engine (block-table KV + radix prefix
+    cache + chunked prefill, ISSUE 7) and stamps kv_hbm_bytes /
+    block_utilization / prefix_hit_rate / prefill_chunks next to the
+    usual numbers; the record always carries the two paged A/Bs —
+    ``paged_capacity`` (>= 2x resident slots at the same HBM budget) and
+    ``prefix_ab`` (shared-system-prompt TTFT with reuse on vs off) —
+    unless PTD_SERVE_AB=0. Runs on CPU-sim or TPU unchanged; knobs via
+    env: PTD_SERVE_SIZE/SLOTS/REQUESTS/RATE/MAX_NEW/PAGED/BLOCK,
+    PTD_QUANT rides the model config like the training benches."""
     import os
 
     import jax
@@ -632,11 +763,14 @@ def bench_serve() -> dict:
     n_requests = int(os.environ.get("PTD_SERVE_REQUESTS", "32"))
     rate = float(os.environ.get("PTD_SERVE_RATE", "8.0"))
     max_new = int(os.environ.get("PTD_SERVE_MAX_NEW", "32"))
+    paged = os.environ.get("PTD_SERVE_PAGED", "0") == "1"
+    block = int(os.environ.get("PTD_SERVE_BLOCK", "16"))
     cfg = gpt2_config(size, scan_layers=False, quant=_quant_override())
     params = jax.jit(GPT2(cfg).init)(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
     engine = ServingEngine(GPT2(cfg), params, num_slots=num_slots,
-                           prefill_bucket=128)
+                           prefill_bucket=128,
+                           block_size=block if paged else 0)
 
     rng = np.random.default_rng(0)
     lens = rng.integers(16, 97, n_requests)
@@ -646,20 +780,8 @@ def bench_serve() -> dict:
     engine.warmup(prompt_lens=(128,))
     traces0 = dict(serving_engine.TRACE_COUNTS)
 
-    t0 = time.perf_counter()
-    pending = list(zip(arrivals, prompts))
-    while pending or engine.queue_depth or engine.active_count:
-        now = time.perf_counter() - t0
-        while pending and pending[0][0] <= now:
-            _, p = pending.pop(0)
-            engine.submit(p, max_new_tokens=max_new,
-                          sampling=SamplingParams(temperature=0.8, top_k=40,
-                                                  seed=engine.queue_depth))
-        if engine.queue_depth or engine.active_count:
-            engine.step()
-        elif pending:
-            time.sleep(min(0.01, max(0.0, pending[0][0] - now)))
-    s = engine.summary()
+    s, _ = _drive_serve_trace(engine, prompts, arrivals, max_new,
+                              sampling_cls=SamplingParams)
     recompiles = sum(dict(serving_engine.TRACE_COUNTS).values()) \
         - sum(traces0.values())
     result = {"metric": "serve_decode_tokens_per_s",
@@ -670,10 +792,24 @@ def bench_serve() -> dict:
               "requests": n_requests, "num_slots": num_slots,
               "arrival_rate_per_s": rate,
               "prefill_ms_mean": s["prefill_ms_mean"],
+              "kv_hbm_bytes": s["kv_hbm_bytes"],
+              "paged": paged,
               "recompiles": recompiles}
+    if paged:
+        result["block_size"] = block
+        result["block_utilization"] = s["block_utilization"]
+        result["prefix_hit_rate"] = s["prefix_hit_rate"]
+        result["prefill_chunks"] = s["prefill_chunks"]
+        result["preemptions"] = s["preemptions"]
+    engine.close()
+    if os.environ.get("PTD_SERVE_AB", "1") != "0":
+        result["paged_capacity"] = _serve_capacity_ab(block)
+        result["prefix_ab"] = _serve_prefix_ab(block)
     _stamp_overrides(result, ("PTD_SERVE_SIZE", "PTD_SERVE_SLOTS",
                               "PTD_SERVE_REQUESTS", "PTD_SERVE_RATE",
-                              "PTD_SERVE_MAX_NEW", "PTD_QUANT"))
+                              "PTD_SERVE_MAX_NEW", "PTD_SERVE_PAGED",
+                              "PTD_SERVE_BLOCK", "PTD_SERVE_AB",
+                              "PTD_QUANT"))
     return result
 
 

@@ -141,6 +141,16 @@ _QUICK = (
     # tier-1); plus the HLO byte-identity pin for diagnostics-off
     "test_diagnostics.py",
     "test_compiled_invariants.py::test_diag_off_hlo_byte_identical",
+    # paged KV cache (ISSUE 7): the whole file is quick-tier by design —
+    # allocator/radix units, the bitwise paged-attention parity ladder
+    # (ragged + block-boundary + trash-garbage), the Pallas pool-native
+    # twin, paged-engine parity vs generate() (incl. int8, GQA/RoPE,
+    # unrolled layers), prefix-reuse hits, chunked-prefill interleaving,
+    # preempt-requeue bitwise continuity, the every-exit-path block-leak
+    # invariant, the paged zero-recompile tripwire and the report CLI's
+    # serving table — all on test-size models. The paged HLO pins ride
+    # the already-quick test_serving_invariants parametrization.
+    "test_paging.py",
 )
 
 

@@ -36,6 +36,10 @@ def main():
     parser.add_argument("--num-slots", type=int, default=3)
     parser.add_argument("--requests", type=int, default=6)
     parser.add_argument("--telemetry-dir", type=str, default=None)
+    parser.add_argument("--block-size", type=int, default=0,
+                        help="> 0: serve from the paged KV engine "
+                             "(block-table pool + radix prefix reuse + "
+                             "chunked prefill; README 'Paged KV cache')")
     args = parser.parse_args()
 
     ptd.init_process_group()
@@ -58,6 +62,7 @@ def main():
     engine = ServingEngine(
         model, {"params": trainer.state.params["params"]},
         num_slots=args.num_slots, prefill_bucket=16,
+        block_size=args.block_size,
         telemetry_dir=args.telemetry_dir)
     engine.warmup(prompt_lens=(16,))
 

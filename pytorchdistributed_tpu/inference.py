@@ -159,6 +159,20 @@ def reset_cache_positions(cache, new_index):
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def kv_cache_bytes(cache) -> int:
+    """HBM bytes of a decode cache collection's K/V payload (dense rows
+    or the paged block pool — the counter/table leaves are noise).
+    Shared by the serving engine's summary and bench.py's paged-capacity
+    A/B, so both sides of every "same HBM budget" claim are measured by
+    the one function."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        name = getattr(path[-1], "key", str(path[-1]))
+        if name in ("cached_key", "cached_value"):
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+    return total
+
+
 def _zero_cache(model, prompt):
     """A fresh all-zero cache collection for ``model`` at ``prompt``'s
     batch size (shapes via eval_shape — nothing is initialized)."""

@@ -31,7 +31,10 @@ import jax
 import jax.ad_checkpoint
 import jax.numpy as jnp
 
-from pytorchdistributed_tpu.ops.attention import dense_attention
+from pytorchdistributed_tpu.ops.attention import (
+    dense_attention,
+    paged_gather,
+)
 from pytorchdistributed_tpu.parallel.tp import Logical
 
 Dtype = Any
@@ -135,6 +138,24 @@ class TransformerConfig:
     # and batch == decode_slots; 0 keeps the scalar counters generate()
     # uses (all rows advance together).
     decode_slots: int = 0
+    # Paged KV cache (serving/ — ISSUE 7, vLLM's PagedAttention realized
+    # TPU-natively): kv_block_size > 0 replaces each attention layer's
+    # dense [slots, max_seq_len, kv_heads, head_dim] cache with ONE pool
+    # of kv_blocks fixed-size blocks ([kv_blocks, kv_block_size, kv_heads,
+    # head_dim]) plus a per-slot block table ([decode_slots,
+    # max_seq_len/kv_block_size] int32 physical-block ids, a "cache"
+    # variable the serving engine overrides from host state every call).
+    # Writes scatter each slot's token into table[slot, pos//bs] at offset
+    # pos%bs; reads gather the slot's blocks back into position order, so
+    # the masked attention math — and therefore the emitted tokens — stay
+    # BITWISE-equal to the dense path while HBM is bounded by actual
+    # resident tokens instead of slots x max_seq_len. Requires decode=True,
+    # decode_slots >= 1 and max_seq_len % kv_block_size == 0 (block-padded
+    # gathers then cover exactly the dense attend window, keeping the
+    # softmax reduction shapes — hence the bits — identical). kv_blocks
+    # sizes the pool (block 0 is the engine's reserved trash block).
+    kv_block_size: int = 0
+    kv_blocks: int = 0
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -188,6 +209,22 @@ class TransformerConfig:
         if self.decode_slots > 0 and not self.decode:
             raise ValueError("decode_slots > 0 (slot-based decode) requires "
                              "decode=True")
+        if self.kv_block_size < 0 or self.kv_blocks < 0:
+            raise ValueError("kv_block_size / kv_blocks must be >= 0")
+        if self.kv_block_size > 0:
+            if not self.decode or self.decode_slots < 1:
+                raise ValueError(
+                    "paged KV (kv_block_size > 0) requires decode=True and "
+                    "decode_slots >= 1 (the serving engine owns the slots)")
+            if self.max_seq_len % self.kv_block_size:
+                raise ValueError(
+                    f"max_seq_len {self.max_seq_len} must be a multiple of "
+                    f"kv_block_size {self.kv_block_size} (block-padded "
+                    f"gathers must cover exactly the dense attend window)")
+            if self.kv_blocks < 2:
+                raise ValueError(
+                    f"kv_blocks {self.kv_blocks} must be >= 2 (block 0 is "
+                    f"the reserved trash block)")
         if self.decode_attend_len is not None and (
                 self.decode_attend_len < 1
                 or self.decode_attend_len > self.max_seq_len):
@@ -209,6 +246,14 @@ class TransformerConfig:
     def kv_heads(self) -> int:
         return (self.num_kv_heads if self.num_kv_heads is not None
                 else self.num_heads)
+
+    @property
+    def kv_pages(self) -> int:
+        """Block-table width: blocks needed to back one full-context slot
+        (0 when the dense decode cache is in use)."""
+        if not self.kv_block_size:
+            return 0
+        return self.max_seq_len // self.kv_block_size
 
     @property
     def ffn_dim(self) -> int:
@@ -428,35 +473,91 @@ class SelfAttention(nn.Module):
         rep = cfg.num_heads // cfg.kv_heads
 
         if cfg.decode:
-            cached_k = self.variable(
-                "cache", "cached_key", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
-            cached_v = self.variable(
-                "cache", "cached_value", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
-            if not self.is_initializing():
-                if cfg.decode_slots:
-                    # per-row writes: each slot lands at its own position
-                    # (vmapped dynamic_update_slice lowers to a scatter)
-                    row = lambda c, u, i: jax.lax.dynamic_update_slice(  # noqa: E731
-                        c, u, (i, 0, 0))
-                    cached_k.value = jax.vmap(row)(
-                        cached_k.value, k.astype(cfg.dtype), idx)
-                    cached_v.value = jax.vmap(row)(
-                        cached_v.value, v.astype(cfg.dtype), idx)
-                else:
-                    cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-                    cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
-                idx_var.value = idx + s
-            # Static attention window (decode_attend_len): the cache stays
-            # max_seq_len-sized, but scores only cover the slots generation
-            # can actually reach — generate() sets the bound from
-            # prompt_len + max_new_tokens.
-            attend = cfg.decode_attend_len or cfg.max_seq_len
-            kc = cached_k.value[:, :attend]
-            vc = cached_v.value[:, :attend]
+            if cfg.kv_block_size:
+                # Paged KV (ISSUE 7): one pool of fixed-size blocks shared
+                # by every slot + a per-slot block table mapping logical
+                # block p//bs to a physical pool block. The table is a
+                # cache variable only so it rides the collection plumbing
+                # — the serving engine overrides it (and idx) from host
+                # state on every compiled call, which is what makes prefix
+                # reuse and copy-free admission pure host-side
+                # bookkeeping. Falls through to the SAME masked-attention
+                # tail as the dense layout: only where K/V rows live
+                # differs, which is what keeps paged outputs bitwise-equal
+                # to dense.
+                bs_blk = cfg.kv_block_size
+                table_var = self.variable(
+                    "cache", "block_table",
+                    lambda: jnp.zeros((cfg.decode_slots, cfg.kv_pages),
+                                      jnp.int32))
+                cached_k = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (cfg.kv_blocks, bs_blk, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (cfg.kv_blocks, bs_blk, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                if not self.is_initializing():
+                    # scatter each row's s tokens into its table's blocks;
+                    # positions past the context (padded prefill tails)
+                    # drop into the reserved trash block 0 instead of
+                    # clamping onto a live row
+                    pos = idx[:, None] + jnp.arange(s)           # [b, s]
+                    inb = jnp.clip(pos // bs_blk, 0, cfg.kv_pages - 1)
+                    blk = jnp.take_along_axis(table_var.value, inb, axis=1)
+                    blk = jnp.where(pos < cfg.max_seq_len, blk, 0)
+                    off = pos % bs_blk
+                    cached_k.value = cached_k.value.at[blk, off].set(
+                        k.astype(cfg.dtype))
+                    cached_v.value = cached_v.value.at[blk, off].set(
+                        v.astype(cfg.dtype))
+                    idx_var.value = idx + s
+                # gather the attended blocks back into position order:
+                # with max_seq_len % bs == 0 the gathered window is
+                # exactly the dense attend window, so every reduction
+                # below keeps its shape — the bitwise-parity property the
+                # serving tests pin
+                attend = cfg.decode_attend_len or cfg.max_seq_len
+                na = -(-attend // bs_blk)
+                attend = na * bs_blk
+                kc = paged_gather(cached_k.value, table_var.value[:, :na])
+                vc = paged_gather(cached_v.value, table_var.value[:, :na])
+            else:
+                cached_k = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                if not self.is_initializing():
+                    if cfg.decode_slots:
+                        # per-row writes: each slot lands at its own
+                        # position (vmapped dynamic_update_slice lowers to
+                        # a scatter)
+                        row = lambda c, u, i: jax.lax.dynamic_update_slice(  # noqa: E731
+                            c, u, (i, 0, 0))
+                        cached_k.value = jax.vmap(row)(
+                            cached_k.value, k.astype(cfg.dtype), idx)
+                        cached_v.value = jax.vmap(row)(
+                            cached_v.value, v.astype(cfg.dtype), idx)
+                    else:
+                        cached_k.value = jax.lax.dynamic_update_slice(
+                            cached_k.value, k.astype(cfg.dtype),
+                            (0, idx, 0, 0))
+                        cached_v.value = jax.lax.dynamic_update_slice(
+                            cached_v.value, v.astype(cfg.dtype),
+                            (0, idx, 0, 0))
+                    idx_var.value = idx + s
+                # Static attention window (decode_attend_len): the cache
+                # stays max_seq_len-sized, but scores only cover the slots
+                # generation can actually reach — generate() sets the
+                # bound from prompt_len + max_new_tokens.
+                attend = cfg.decode_attend_len or cfg.max_seq_len
+                kc = cached_k.value[:, :attend]
+                vc = cached_v.value[:, :attend]
             if rep > 1:
                 kc = jnp.repeat(kc, rep, axis=2)
                 vc = jnp.repeat(vc, rep, axis=2)

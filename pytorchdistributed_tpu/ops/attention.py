@@ -26,6 +26,69 @@ def causal_mask(q_len: int, kv_len: int, *, q_offset: int = 0,
     return jnp.where(q_pos >= kv_pos, 0.0, -jnp.inf).astype(dtype)
 
 
+def paged_gather(pool, block_tables):
+    """Gather block-table paged K or V back into position order.
+
+    ``pool`` is the engine's shared block pool ``[num_blocks, block_size,
+    kv_heads, head_dim]``; ``block_tables`` maps each slot's logical block
+    j (positions [j*bs, (j+1)*bs)) to a physical pool block:
+    ``[slots, blocks_per_slot]`` int32. Returns ``[slots,
+    blocks_per_slot*block_size, kv_heads, head_dim]`` — the exact tensor
+    the dense per-slot cache would hold over that window, so downstream
+    masked attention is bitwise-identical to the dense path. Table
+    entries past a slot's live length point at the reserved trash block
+    (0); their rows are finite garbage the position mask zeroes exactly.
+    """
+    g = pool[block_tables]          # [slots, nb, bs, kv_heads, head_dim]
+    slots, nb, bs = g.shape[:3]
+    return g.reshape(slots, nb * bs, *g.shape[3:])
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                    scale: float | None = None) -> jax.Array:
+    """Reference paged decode attention — the math twin of the serving
+    tick's in-model path (models/transformer.py paged branch), exposed so
+    the parity tests and the Pallas kernel have a standalone oracle.
+
+    Args:
+      q: ``[slots, q_len, heads, head_dim]`` current-chunk queries (q_len
+        is 1 for a decode tick, >1 for a chunked-prefill step).
+      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``.
+      block_tables: ``[slots, blocks_per_slot]`` int32.
+      lengths: ``[slots]`` int32 — tokens already cached per slot; query
+        token i of a slot sits at absolute position lengths + i and
+        attends cache positions <= it. The CURRENT chunk's K/V must
+        already be written into the pool (the model writes before it
+        attends), exactly like the dense decode contract.
+
+    Returns ``[slots, q_len, heads, head_dim]`` in q's dtype. Bitwise
+    equal (fp32 accumulate, fp32 softmax) to the dense cache path over
+    the same window — including the ``/ sqrt(d)`` spelling of the scale
+    (multiplying by the reciprocal rounds differently), when ``scale`` is
+    left at None.
+    """
+    head_dim = q.shape[-1]
+    kc = paged_gather(k_pool, block_tables)
+    vc = paged_gather(v_pool, block_tables)
+    rep = q.shape[2] // kc.shape[2]
+    if rep > 1:
+        kc = jnp.repeat(kc, rep, axis=2)
+        vc = jnp.repeat(vc, rep, axis=2)
+    pos = lengths[:, None] + jnp.arange(q.shape[1])          # [slots, q]
+    valid = jnp.arange(kc.shape[1]) <= pos[..., None]        # [slots, q, j]
+    scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
+                        preferred_element_type=jnp.float32)
+    if scale is None:
+        scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
+    else:
+        scores = scores * scale
+    scores = jnp.where(valid[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhij,bjhd->bihd", probs.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,

@@ -466,3 +466,131 @@ def flash_attention(q, k, v, *, causal: bool = False,
     out = _flash(fold(q), fold(k), fold(v), causal, scale, block_q, block_k,
                  interpret)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention (ISSUE 7): the Pallas twin of
+# ops/attention.paged_attention. One decode tick's q ([slots, heads, d])
+# attends each slot's block-table-mapped KV blocks streamed STRAIGHT from
+# the shared pool — the [slots, blocks*block_size, ...] gathered copy the
+# reference path materializes in HBM never exists here. The block table
+# and per-slot lengths ride as scalar-prefetch operands so the KV
+# BlockSpec index maps can chase the table (pool block `tables[slot, j]`
+# is DMA'd as grid step j), the canonical PagedAttention dataflow.
+
+
+def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_s, m_s, l_s, *, block_size: int, num_blocks: int,
+                  kv_heads: int, scale: float):
+    """Online-softmax over one slot's table blocks; grid
+    (slots·kv_heads, blocks_per_slot), rows = the kv head's q group."""
+    b, ji = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(ji == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    length = lengths_ref[b // kv_heads]
+    # skip blocks wholly past the slot's live window (the current token
+    # sits at position `length`, so positions <= length are attendable);
+    # dead slots (length 0) still run block 0 — masked rows are exact
+    # zeros, the same garbage-tolerance contract as the reference path
+    run = ji * block_size <= length
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                                       # [group, d]
+        k = k_ref[0, :, 0]                                 # [bs, d]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [group, bs]
+        pos = ji * block_size + lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        valid = pos <= length
+        logits = jnp.where(valid, logits, _NEG_INF)
+        m_prev = m_s[...]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, -1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
+        l_s[...] = l_s[...] * corr + jnp.sum(p, -1, keepdims=True)
+        m_s[...] = m_new
+        v = v_ref[0, :, 0]                                 # [bs, d]
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ji == num_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_s[...]
+                    / jnp.maximum(l_s[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_flash_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                          scale: float | None = None,
+                          interpret: bool | None = None):
+    """One decode tick of paged attention, pool-native.
+
+    Args:
+      q: ``[slots, heads, head_dim]`` — each slot's single current-token
+        query (its K/V already written into the pool, the decode
+        contract).
+      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``.
+      block_tables: ``[slots, blocks_per_slot]`` int32 physical block ids
+        (entries past a slot's live length point at the trash block 0).
+      lengths: ``[slots]`` int32 — the query attends positions <= length.
+
+    Returns ``[slots, heads, head_dim]``. Matches
+    ops.attention.paged_attention to fp32 online-softmax tolerance (the
+    reassociated flash recurrence is not bitwise — the serving tick's
+    pinned-parity path stays on the reference gather; this kernel is the
+    HBM-traffic-optimal twin for pool sizes where the gathered copy
+    dominates). Grouped-query native: each (slot, kv_head) program
+    streams its group's shared KV block once. On TPU the group width
+    (heads/kv_heads) rides the sublane dim — pad q to a multiple of 8
+    rows for compiled-mode tiling; interpret mode (the CPU sim) has no
+    such constraint."""
+    slots, h, d = q.shape
+    nb, bs, hk, _ = k_pool.shape
+    if h % hk:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
+    group = h // hk
+    mb = block_tables.shape[1]
+    scale = (d**-0.5) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    from jax.experimental.pallas import tpu as pltpu
+
+    qf = q.reshape(slots * hk, group, d)  # kv head g owns q rows g·group+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots * hk, mb),
+        in_specs=[
+            pl.BlockSpec((1, group, d), lambda b, j, tbl, ln: (b, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda b, j, tbl, ln: (tbl[b // hk, j], 0,
+                                                b % hk, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda b, j, tbl, ln: (tbl[b // hk, j], 0,
+                                                b % hk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, group, d),
+                               lambda b, j, tbl, ln: (b, 0, 0)),
+        scratch_shapes=[
+            _vmem_scratch((group, d)),
+            _vmem_scratch((group, 1)),
+            _vmem_scratch((group, 1)),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, block_size=bs, num_blocks=mb, kv_heads=hk,
+        scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots * hk, group, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qf, k_pool, v_pool)
+    return out.reshape(slots, h, d)

@@ -3,13 +3,21 @@
   * engine.py    — ServingEngine: fixed-slot KV cache + one compiled
                    decode tick + bucketed prefill-into-slot, with a
                    host-side admission/retirement scheduler and
-                   per-request token streaming
+                   per-request token streaming. ``block_size > 0``
+                   switches to the PAGED engine (ISSUE 7): block-table
+                   KV pool, radix prefix reuse, chunked prefill,
+                   preempt-requeue
+  * paging.py    — BlockAllocator (refcounted pool free-list, trash
+                   block, leak invariant) + RadixPrefixCache
+                   (block-granularity prefix trie, LRU eviction)
   * telemetry.py — ServingTelemetry: TTFT / tokens-per-s / queue depth /
-                   slot occupancy as spans + metric JSONL through the
-                   existing telemetry/ package
+                   slot occupancy / prefix-cache + block-pool metrics as
+                   spans + metric JSONL through the existing telemetry/
+                   package
 
-`bench.py --mode serve` drives it under a Poisson arrival trace;
-examples/serve.py is the train-then-serve demo.
+`bench.py --mode serve` drives it under a Poisson arrival trace (plus
+the paged capacity and prefix-reuse A/Bs); examples/serve.py is the
+train-then-serve demo.
 """
 
 from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
@@ -17,8 +25,15 @@ from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
     SamplingParams,
     ServingEngine,
     decode_tick,
+    paged_decode_tick,
+    paged_prefill_chunk,
+    paged_slot_models,
     prefill_into_slot,
     slot_models,
+)
+from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
+    BlockAllocator,
+    RadixPrefixCache,
 )
 from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
     SERVE_METRICS_FILE,

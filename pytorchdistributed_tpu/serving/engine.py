@@ -28,11 +28,24 @@ vLLM-style fixed-slot cache, realized TPU-natively:
     `stream()` iterator), and bridges TTFT / tokens-per-s / queue depth /
     slot occupancy into telemetry/ (serving.telemetry).
 
+Paged mode (ISSUE 7, ``block_size > 0``) swaps the dense per-slot cache
+for a block-table **paged KV pool** (vLLM's PagedAttention,
+TPU-natively): one donated pool of fixed-size KV blocks + per-slot
+block tables gathered inside the same compiled tick, a host-side
+**radix prefix cache** admitting shared prompt prefixes by refcounted
+block reference instead of re-prefilling, **chunked prefill**
+interleaving long admissions with decode ticks, and preempt-requeue
+under pool pressure — HBM then bounds actual resident tokens, not
+slots x max_seq_len. Tables/lengths are host numpy stamped into each
+call as dynamic arguments, so all of it is host bookkeeping between
+two fixed compiled programs (paged_decode_tick / paged_prefill_chunk).
+
 Composition: params may be dp/tp sharded (pass the mesh) and quantized
 (`--quant` int8 policies) exactly as generate() accepts them — the tick
 and prefill run the same decode einsums under the same logical rules.
 Greedy outputs are bitwise-equal to generate()'s per request, for any
-admission order (tests/test_serving.py pins it).
+admission order — prefix hits, chunk boundaries and preemptions
+included (tests/test_serving.py + tests/test_paging.py pin it).
 """
 
 from __future__ import annotations
@@ -50,8 +63,13 @@ import numpy as np
 
 from pytorchdistributed_tpu.inference import (
     _zero_cache,
+    kv_cache_bytes,
     sample_slots,
     stop_ids_tuple,
+)
+from pytorchdistributed_tpu.serving.paging import (
+    BlockAllocator,
+    RadixPrefixCache,
 )
 from pytorchdistributed_tpu.serving.telemetry import ServingTelemetry
 
@@ -153,6 +171,121 @@ def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
     return new_cache, first
 
 
+def paged_slot_models(model, num_slots: int, block_size: int,
+                      num_blocks: int):
+    """(tick_model, chunk_model) for the PAGED engine: both share the one
+    block pool (pool shapes carry no slot dim); the tick model decodes
+    all ``num_slots`` rows, the chunk model runs one request's prefill
+    chunk at batch 1 (``decode_slots=1``) against the same pool. Same
+    dense-path pinning rationale as slot_models."""
+    cfg = dataclasses.replace(
+        model.cfg, decode=True, attention="dense", decode_attend_len=None,
+        decode_slots=num_slots, kv_block_size=block_size,
+        kv_blocks=num_blocks)
+    return (model.clone(cfg=cfg),
+            model.clone(cfg=dataclasses.replace(cfg, decode_slots=1)))
+
+
+def _override_paging(cache, tables, lengths):
+    """Stamp the host scheduler's block tables + per-slot lengths over
+    the cache collection's counter/table leaves (every layer reads the
+    same values — leaves just broadcast up the scan axis). The device
+    copies are write-through scratch: the engine re-stamps from host
+    state on every compiled call, which is what makes prefix sharing,
+    block growth and preemption pure host bookkeeping."""
+    def fix(path, leaf):
+        name = _leaf_name(path)
+        if name in ("index", "pos_index"):
+            return jnp.broadcast_to(lengths, leaf.shape).astype(leaf.dtype)
+        if name == "block_table":
+            return jnp.broadcast_to(tables, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "candidates"),
+    donate_argnames=("cache",))
+def paged_decode_tick(model, weights, cache, tables, lengths, tokens,
+                      key_data, counts, temperature, top_k, top_p, *,
+                      candidates: int):
+    """The paged twin of decode_tick: same one-apply-over-[slots, 1]
+    shape, but K/V live in the donated block POOL and each slot's rows
+    are table-gathered inside the compiled program
+    (models/transformer.py paged branch). ``tables``/``lengths`` arrive
+    from host state every call — free slots carry all-trash tables and
+    length 0, so their garbage ticks write the reserved trash block and
+    can never corrupt a live request's blocks."""
+    TRACE_COUNTS["paged_decode_tick"] += 1
+    cache = _override_paging(cache, tables, lengths)
+    logits, mut = model.apply({"params": weights, "cache": cache},
+                              tokens[:, None], mutable=["cache"])
+    keys = jax.random.wrap_key_data(key_data)
+    subs = jax.vmap(jax.random.fold_in)(keys, counts)
+    nxt = sample_slots(logits[:, 0].astype(jnp.float32), subs,
+                       temperature, top_k, top_p, candidates=candidates)
+    return mut["cache"], nxt
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "candidates"),
+    donate_argnames=("cache",))
+def paged_prefill_chunk(model, weights, cache, chunk, start, table_row,
+                        true_len, key_data, count, temperature, top_k,
+                        top_p, *, candidates: int):
+    """One fixed-size prefill chunk of one request, written straight
+    into ITS blocks of the shared pool. ``chunk`` is [1, C] tokens
+    covering absolute positions [start, start+C) (right-padded past
+    true_len — pad K/V lands beyond the position mask, or in the trash
+    block past max_seq_len, until decode overwrites it); ``start`` is
+    dynamic, so a prefix-cache hit just starts chunking at the first
+    unmatched block with the SAME compiled program. Chunking long
+    prompts into C-token calls is what lets the scheduler interleave
+    resident slots' decode ticks between chunks — a long admission no
+    longer head-of-line-blocks their TTFT. Samples the request's next
+    token at the (dynamic) last true position — only the final chunk's
+    sample is used; ``count`` is its fold_in index (> 0 when a preempted
+    request resumes mid-generation)."""
+    TRACE_COUNTS["paged_prefill_chunk"] += 1
+
+    def shrink(path, leaf):
+        # the chunk model is the same module tree at decode_slots=1:
+        # pool leaves pass through untouched (no slot dim), counter and
+        # table leaves shrink to the one-request row
+        name = _leaf_name(path)
+        if name in ("index", "pos_index"):
+            return jnp.broadcast_to(
+                start, leaf.shape[:-1] + (1,)).astype(leaf.dtype)
+        if name == "block_table":
+            return jnp.broadcast_to(
+                table_row,
+                leaf.shape[:-2] + (1,) + table_row.shape).astype(leaf.dtype)
+        return leaf
+
+    small = jax.tree_util.tree_map_with_path(shrink, cache)
+    logits, mut = model.apply({"params": weights, "cache": small}, chunk,
+                              mutable=["cache"])
+
+    def merge(path, big, new):
+        # only the pools mutated; the big cache's counter/table leaves
+        # are scratch the engine re-stamps anyway
+        return (new if _leaf_name(path) in ("cached_key", "cached_value")
+                else big)
+
+    new_cache = jax.tree_util.tree_map_with_path(merge, cache, mut["cache"])
+    off = jnp.clip(true_len - 1 - start, 0, chunk.shape[1] - 1)
+    last = jax.lax.dynamic_slice_in_dim(logits, off, 1, axis=1)
+    keys = jax.random.wrap_key_data(key_data[None])
+    subs = jax.vmap(jax.random.fold_in)(keys, count[None])
+    first = sample_slots(last[:, 0].astype(jnp.float32), subs,
+                         temperature[None], top_k[None], top_p[None],
+                         candidates=candidates)[0]
+    return new_cache, first
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs (dynamic per slot — any mix of requests
@@ -190,6 +323,15 @@ class Request:
         self.submit_time: float | None = None
         self.first_token_time: float | None = None
         self.finish_time: float | None = None
+        # paged-engine lifecycle (zero on the dense engine): prompt
+        # tokens admitted from the prefix cache instead of prefill
+        # compute, chunked-prefill calls paid, and preempt-requeue
+        # round-trips survived (a preempted request resumes by
+        # re-prefilling prompt + already-generated tokens — its output
+        # stream is unchanged)
+        self.prefix_hit_tokens = 0
+        self.prefill_chunks = 0
+        self.preemptions = 0
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -235,24 +377,102 @@ class ServingEngine:
         trace under it, exactly like generate().
       telemetry / telemetry_dir: a ServingTelemetry (or a run dir to
         build one) for spans + serve-metric JSONL; None = off.
+      block_size: > 0 switches to the PAGED KV cache (ISSUE 7): one pool
+        of ``num_blocks`` blocks of this many tokens replaces the dense
+        per-slot rows — HBM is then bounded by tokens actually resident,
+        not slots x max_seq_len. Must divide max_seq_len. 0 = the dense
+        engine (unchanged). A model whose config already sets
+        kv_block_size/kv_blocks turns paging on implicitly.
+      num_blocks: pool size in blocks (block 0 is the reserved trash
+        block). Default = dense-equivalent HBM (num_slots full contexts
+        + 1); SHRINK it to oversubscribe slots — exhaustion first evicts
+        prefix-cache LRU entries, then preempts the youngest resident
+        request (requeued; it resumes by re-prefilling prompt +
+        generated, its output stream unchanged).
+      prefill_chunk: paged prompts prefill in fixed chunks of this many
+        tokens (default prefill_bucket, rounded to a block multiple)
+        interleaved with decode ticks, so a long admission cannot
+        head-of-line-block resident streams' tokens.
+      prefix_cache: host-side radix cache over full prompt blocks —
+        prompts sharing a cached prefix admit by block REFERENCE
+        (refcounted, copy-on-write by construction: shared blocks are
+        never written) instead of re-running prefill. On by default in
+        paged mode.
+      prefill_chunks_per_step: chunk calls per step() once slots are
+        decoding (1 = maximally latency-protective interleaving).
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
                  prefill_bucket: int = 128, candidates: int = 64,
                  mesh=None, telemetry: ServingTelemetry | None = None,
-                 telemetry_dir=None):
+                 telemetry_dir=None, block_size: int = 0,
+                 num_blocks: int | None = None,
+                 prefill_chunk: int | None = None,
+                 prefix_cache: bool = True,
+                 prefill_chunks_per_step: int = 1):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
         self.candidates = candidates
         self.mesh = mesh
-        self._tick_model, self._prefill_model = slot_models(model, num_slots)
+        if block_size == 0 and model.cfg.kv_block_size:
+            # a model already configured paged carries the knobs
+            block_size = model.cfg.kv_block_size
+            num_blocks = num_blocks or model.cfg.kv_blocks
+        self.paged = block_size > 0
+        if self.paged:
+            max_len = model.cfg.max_seq_len
+            if max_len % block_size:
+                raise ValueError(
+                    f"block_size {block_size} must divide max_seq_len "
+                    f"{max_len}")
+            pages = max_len // block_size
+            if num_blocks is None:
+                # dense-equivalent HBM by default: one full context per
+                # slot, plus the trash block — shrink it to oversubscribe
+                num_blocks = num_slots * pages + 1
+            if num_blocks < pages + 1:
+                raise ValueError(
+                    f"num_blocks {num_blocks} cannot back even one "
+                    f"full-context request (need >= {pages + 1}: "
+                    f"max_seq_len/block_size + the trash block)")
+            self.block_size = block_size
+            self.num_blocks = num_blocks
+            self._tick_model, self._chunk_model = paged_slot_models(
+                model, num_slots, block_size, num_blocks)
+            self._prefill_model = None
+        else:
+            self.block_size = 0
+            self.num_blocks = 0
+            self._tick_model, self._prefill_model = slot_models(
+                model, num_slots)
         self.cfg = self._tick_model.cfg
         self.bucket = max(1, min(prefill_bucket, self.cfg.max_seq_len))
+        if self.paged:
+            chunk = prefill_chunk if prefill_chunk else self.bucket
+            # chunks must tile the block grid (a chunk's writes stay in
+            # whole blocks) and fit the context
+            self.chunk = min(self._round_up(chunk, block_size),
+                             self.cfg.max_seq_len)
+            self._chunks_per_step = max(1, prefill_chunks_per_step)
+            self._alloc = BlockAllocator(num_blocks, block_size)
+            self._radix = (RadixPrefixCache(self._alloc) if prefix_cache
+                           else None)
+            self._tables = np.zeros((num_slots, self.cfg.kv_pages),
+                                    np.int32)
+            self._lengths = np.zeros(num_slots, np.int32)
+            self._slot_blocks: list[list[int]] = [
+                [] for _ in range(num_slots)]
+            self._admit_order = np.zeros(num_slots, np.int64)
+            self._admit_seq = itertools.count(1)
+            self._prefilling: dict | None = None
         self._weights = params["params"] if "params" in params else params
         with self._mesh_ctx():
             self._cache = _zero_cache(
                 self._tick_model, jnp.zeros((num_slots, 1), jnp.int32))
+        # the KV cache HBM footprint (pool or dense rows) — the bench's
+        # capacity-per-byte denominator
+        self.kv_hbm_bytes = kv_cache_bytes(self._cache)
         kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         self._key_data = np.zeros((num_slots,) + kd.shape, kd.dtype)
         self._tokens = np.zeros(num_slots, np.int32)
@@ -263,6 +483,7 @@ class ServingEngine:
         self._free = list(reversed(range(num_slots)))  # pop() -> slot 0
         self._queue: collections.deque[Request] = collections.deque()
         self._active: dict[int, Request] = {}
+        self._draining = False
         if telemetry is None and telemetry_dir is not None:
             telemetry = ServingTelemetry(telemetry_dir)
         self.telemetry = telemetry
@@ -309,20 +530,37 @@ class ServingEngine:
 
     def step(self) -> dict:
         """One scheduler iteration: shed deadline-expired requests, admit
-        prefills while slots are free, then ONE decode tick over all
-        slots; deliver + retire from the synced tokens. Returns a small
-        stats dict."""
+        prefills while slots are free (paged: at most
+        ``prefill_chunks_per_step`` chunks once slots are decoding, so a
+        long admission interleaves with — instead of blocking — resident
+        streams), then ONE decode tick over all slots; deliver + retire
+        from the synced tokens. Returns a small stats dict."""
+        if self._draining:
+            self.drain()
+            return {"admitted": 0, "decoded": 0, "expired": 0,
+                    "active": 0, "queued": 0}
         expired = self._expire_deadlines()
         admitted = 0
-        while self._free and self._queue:
-            self._admit(self._queue.popleft())
-            admitted += 1
+        if self.paged:
+            admitted = self._paged_admissions()
+        else:
+            while self._free and self._queue:
+                self._admit(self._queue.popleft())
+                admitted += 1
         decoded = 0
+        if self.paged and self._active:
+            self._grow_slots()  # back this tick's write positions
         if self._active:
             t0 = time.perf_counter()
             with self._span("serve/decode_tick"), self._mesh_ctx():
-                self._cache, nxt = decode_tick(
-                    self._tick_model, self._weights, self._cache,
+                # one shared per-slot argument tail; the paged tick just
+                # prepends the host-stamped block tables and lengths
+                tick, head = ((paged_decode_tick,
+                               (jnp.asarray(self._tables),
+                                jnp.asarray(self._lengths)))
+                              if self.paged else (decode_tick, ()))
+                self._cache, nxt = tick(
+                    self._tick_model, self._weights, self._cache, *head,
                     jnp.asarray(self._tokens),
                     jnp.asarray(self._key_data),
                     jnp.asarray(self._counts),
@@ -337,6 +575,14 @@ class ServingEngine:
             st["ticks"] += 1
             st["tick_s"] += dt
             st["occupancy_sum"] += len(self._active) / self.num_slots
+            row = {}
+            if self.paged:
+                used = self._alloc.usable - self._alloc.free_count
+                st["block_used_sum"] += used / self._alloc.usable
+                row = dict(blocks_used=used,
+                           blocks_free=self._alloc.free_count)
+                for slot in self._active:
+                    self._lengths[slot] += 1  # this tick's write landed
             for slot, req in list(self._active.items()):
                 self._deliver(req, int(toks[slot]))
                 decoded += 1
@@ -345,10 +591,221 @@ class ServingEngine:
                 self.telemetry.tick(
                     tick=st["ticks"], tick_ms=round(dt * 1e3, 3),
                     active=len(self._active), queued=len(self._queue),
-                    slot_occupancy=round(decoded / self.num_slots, 4))
+                    slot_occupancy=round(decoded / self.num_slots, 4),
+                    **row)
         return {"admitted": admitted, "decoded": decoded,
                 "expired": expired, "active": len(self._active),
                 "queued": len(self._queue)}
+
+    # ------------------------------------------------------------------
+    # paged admission: chunked prefill + prefix reuse + block accounting
+
+    @staticmethod
+    def _round_up(n: int, q: int) -> int:
+        return -(-n // q) * q
+
+    def _paged_admissions(self) -> int:
+        """Advance the admission pipeline: while nothing is decoding,
+        push the current prefill to completion and keep admitting (an
+        idle engine has no TTFT to protect); once slots are live, spend
+        at most ``prefill_chunks_per_step`` chunk calls so resident
+        streams keep ticking between chunks."""
+        admitted = chunks = 0
+        while True:
+            if self._prefilling is None:
+                if not (self._queue and self._free):
+                    break
+                if not self._start_prefill():
+                    break  # pool pressure: wait for retirements
+            admitted += self._prefill_chunk_step()
+            chunks += 1
+            if self._active and chunks >= self._chunks_per_step:
+                break
+        return admitted
+
+    def _alloc_blocks(self, n: int):
+        """Allocate n blocks, evicting prefix-cache LRU entries if the
+        free list is short — but only when eviction can actually cover
+        the shortfall (a doomed allocation must not destroy reusable
+        cached prefixes on its way to failing anyway). None when it
+        cannot be covered."""
+        fresh = self._alloc.alloc(n)
+        if fresh is None and self._radix is not None:
+            short = n - self._alloc.free_count
+            if self._radix.evictable_count() >= short:
+                self._radix.reclaim(short)
+                fresh = self._alloc.alloc(n)
+        return fresh
+
+    def _start_prefill(self) -> bool:
+        """Begin admitting the queue head: match its prompt against the
+        radix cache (matched FULL blocks are admitted by reference — no
+        prefill compute), allocate private blocks for the rest, claim a
+        slot. The last prompt token is never taken from the cache: its
+        forward pass produces the logits the first sampled token needs.
+        Returns False when the pool cannot back it yet."""
+        req = self._queue[0]
+        # a preempted request resumes by re-prefilling prompt + what it
+        # already generated — continuation tokens, sampling stream and
+        # the delivered output are unchanged
+        tokens = np.concatenate(
+            [req.prompt, np.asarray(req.new_tokens, np.int32)])
+        true_len = int(tokens.size)
+        bs = self.block_size
+        lookup_len = ((true_len - 1) // bs) * bs
+        matched: list[int] = []
+        if self._radix is not None:
+            matched = self._radix.match(tokens[:lookup_len])
+        for b in matched:  # hold them before eviction can reap them
+            self._alloc.incref(b)
+        m = len(matched) * bs
+        span = min(self._round_up(true_len - m, self.chunk),
+                   self.cfg.max_seq_len - m)
+        fresh = self._alloc_blocks(self._round_up(span, bs) // bs)
+        if fresh is None and not self._active and m:
+            # nothing will retire and the shared prefix is squatting the
+            # pool: fall back to a full private prefill so the lone
+            # request can make progress
+            for b in matched:
+                self._alloc.decref(b)
+            matched, m = [], 0
+            if self._radix is not None:
+                self._radix.clear()
+            span = min(self._round_up(true_len, self.chunk),
+                       self.cfg.max_seq_len)
+            fresh = self._alloc_blocks(self._round_up(span, bs) // bs)
+        if fresh is None:
+            for b in matched:
+                self._alloc.decref(b)
+            return False
+        self._queue.popleft()
+        if self._radix is not None:  # ONE stat row per landed admission
+            self._radix.record_admission(len(matched), lookup_len)
+        slot = self._free.pop()
+        blocks = matched + fresh
+        self._slot_blocks[slot] = blocks
+        # the TICK's view of this slot (self._tables/_lengths) stays
+        # all-trash until activation: decode ticks keep running between
+        # prefill chunks, and the mid-prefill slot's garbage tick must
+        # write the trash block, not position 0 of the request's first
+        # real block. The chunk program reads the real row from pf state.
+        table_row = np.zeros(self.cfg.kv_pages, np.int32)
+        table_row[:len(blocks)] = blocks
+        req.prefix_hit_tokens += m
+        st = self._stats
+        st["admissions"] += 1
+        st["admitted_tokens"] += true_len
+        st["prefix_hit_tokens"] += m
+        self._prefilling = dict(
+            req=req, slot=slot, tokens=tokens, true_len=true_len, pos=m,
+            resume=len(req.new_tokens), table_row=table_row,
+            kd=np.asarray(jax.random.key_data(
+                jax.random.key(req.sampling.seed))))
+        return True
+
+    def _prefill_chunk_step(self) -> int:
+        """Run ONE chunk of the in-flight admission; on the final chunk,
+        sample the request's next token and activate the slot. Returns 1
+        on completed admission, else 0."""
+        pf = self._prefilling
+        req, slot, pos = pf["req"], pf["slot"], pf["pos"]
+        chunk = np.zeros((1, self.chunk), np.int32)
+        n = min(self.chunk, pf["true_len"] - pos)
+        chunk[0, :n] = pf["tokens"][pos:pos + n]
+        final = pos + self.chunk >= pf["true_len"]
+        t0 = time.perf_counter()
+        with self._span("serve/prefill"), self._mesh_ctx():
+            self._cache, first = paged_prefill_chunk(
+                self._chunk_model, self._weights, self._cache,
+                jnp.asarray(chunk), jnp.int32(pos),
+                jnp.asarray(pf["table_row"]),
+                jnp.int32(pf["true_len"]),
+                jnp.asarray(pf["kd"]),
+                jnp.int32(pf["resume"]),
+                jnp.float32(req.sampling.temperature),
+                jnp.int32(req.sampling.top_k),
+                jnp.float32(req.sampling.top_p),
+                candidates=self.candidates)
+            if final:
+                first = int(first)  # sync: the TTFT timestamp is honest
+        now = time.perf_counter()
+        st = self._stats
+        st["prefill_s"] += now - t0
+        st["prefill_chunks"] += 1
+        req.prefill_chunks += 1
+        pf["pos"] = pos + self.chunk
+        if not final:
+            return 0
+        # admission complete: cache the prompt's full blocks for future
+        # arrivals, publish the real table to the tick's view, rewind to
+        # the true length, activate the slot
+        self._tables[slot, :] = pf["table_row"]
+        self._lengths[slot] = pf["true_len"]
+        if self._radix is not None:
+            nb = pf["true_len"] // self.block_size
+            self._radix.insert(pf["tokens"][:nb * self.block_size],
+                               self._slot_blocks[slot][:nb])
+        self._prefilling = None
+        st["prefills"] += 1
+        req.slot = slot
+        if req.first_token_time is None:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                st["ttft_s"].append(now - req.submit_time)
+        self._active[slot] = req
+        self._admit_order[slot] = next(self._admit_seq)
+        self._key_data[slot] = pf["kd"]
+        self._counts[slot] = pf["resume"] + 1
+        self._temps[slot] = req.sampling.temperature
+        self._top_ks[slot] = req.sampling.top_k
+        self._top_ps[slot] = req.sampling.top_p
+        self._deliver(req, first)
+        return 1
+
+    def _grow_slots(self) -> None:
+        """Back every active slot's next write position with a physical
+        block, oldest admissions first. When the pool is exhausted even
+        after prefix-cache eviction, preempt the YOUNGEST resident
+        request (free its blocks, requeue it at the front — it resumes
+        later by re-prefilling prompt + generated, output unchanged)
+        until the older stream can proceed."""
+        for slot in sorted(self._active,
+                           key=lambda s: self._admit_order[s]):
+            if slot not in self._active:
+                continue  # preempted by an older slot's growth
+            blocks = self._slot_blocks[slot]
+            bi = int(self._lengths[slot]) // self.block_size
+            while bi >= len(blocks):
+                fresh = self._alloc_blocks(1)
+                if fresh is not None:
+                    self._tables[slot, len(blocks)] = fresh[0]
+                    blocks.append(fresh[0])
+                    continue
+                victim = max(self._active,
+                             key=lambda s: self._admit_order[s])
+                self._preempt(victim)
+                if victim == slot:
+                    break  # this very request went back to the queue
+
+    def _preempt(self, slot: int) -> None:
+        req = self._active.pop(slot)
+        self._release_slot(slot)
+        req.slot = None
+        req.preemptions += 1
+        self._stats["preemptions"] += 1
+        self._queue.appendleft(req)
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot's blocks to the pool (radix-cached blocks
+        survive via the cache's own reference) and point its table at
+        the trash block so its garbage ticks stay harmless."""
+        for b in self._slot_blocks[slot]:
+            self._alloc.decref(b)
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = 0
+        self._lengths[slot] = 0
+        self._free.append(slot)
+        self._temps[slot] = 0.0
 
     def _expire_deadlines(self) -> int:
         """Retire every request past its ``deadline_s`` — still queued
@@ -364,18 +821,27 @@ class ServingEngine:
 
         expired = ([r for r in self._queue if overdue(r)]
                    + [r for r in self._active.values() if overdue(r)])
+        pf = getattr(self, "_prefilling", None) if self.paged else None
+        if pf is not None and overdue(pf["req"]):
+            # mid-chunked-prefill expiry: abandon the admission, free
+            # its blocks and slot before it ever decodes
+            self._release_slot(pf["slot"])
+            self._prefilling = None
+            expired.append(pf["req"])
         if not expired:
             return 0
         with self._span("serve/deadline_retire"):
             for req in expired:
-                if req.slot is None:
+                if req.slot is None and req in self._queue:
                     self._queue.remove(req)
                 self._retire(req, "deadline")
         return len(expired)
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
-        """Step until queue and slots drain (tests / batch-mode use)."""
-        while self._queue or self._active:
+        """Step until queue, in-flight prefill and slots drain (tests /
+        batch-mode use)."""
+        while (self._queue or self._active
+               or (self.paged and self._prefilling is not None)):
             if max_steps <= 0:
                 raise RuntimeError("serving loop did not drain")
             self.step()
@@ -412,9 +878,69 @@ class ServingEngine:
             n = max(1, min(n, self.cfg.max_seq_len - max_new_tokens))
             self.submit(np.zeros(n, np.int32), max_new_tokens=max_new_tokens)
             self.run_until_idle()
+        if self.paged and self._radix is not None:
+            self._radix.clear()  # don't serve real traffic warmup zeros
+            self._radix.reset_stats()
         self.reset_stats()
 
+    def drain(self) -> list[Request]:
+        """Retire EVERY request — queued, mid-prefill, resident — with
+        finish_reason "drained" and free their slots/blocks: the SIGTERM
+        / shutdown exit path (pair with request_drain() from a signal
+        handler; close() also drains). Returns the drained requests."""
+        self._draining = False
+        out: list[Request] = []
+        if self.paged and self._prefilling is not None:
+            pf, self._prefilling = self._prefilling, None
+            self._release_slot(pf["slot"])
+            out.append(pf["req"])
+        while self._queue:
+            out.append(self._queue.popleft())
+        out.extend(self._active.values())
+        with self._span("serve/drain"):
+            for req in out:
+                self._retire(req, "drained")
+        return out
+
+    def request_drain(self) -> None:
+        """Signal-handler-safe drain request: sets a flag the next
+        step() honors (draining involves device/telemetry work that must
+        not run inside a signal frame — the same finish-the-step
+        discipline as the Trainer's SIGTERM checkpoint)."""
+        self._draining = True
+
+    def install_sigterm_drain(self) -> None:
+        """Route SIGTERM to request_drain() — a preempted serving tier
+        sheds its requests (streams get finish_reason "drained") instead
+        of dying mid-tick with the pool in limbo."""
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: self.request_drain())
+
     def close(self) -> None:
+        """Drain outstanding work, assert the paged pool's leak
+        invariant (free + resident == pool: every retirement path must
+        have returned its blocks), and flush telemetry."""
+        self.drain()
+        if self.paged:
+            if self.telemetry is not None:
+                st = self._stats
+                self.telemetry.pool(
+                    kv_hbm_bytes=self.kv_hbm_bytes,
+                    block_size=self.block_size,
+                    num_blocks=self.num_blocks,
+                    prefill_chunks=st["prefill_chunks"],
+                    preemptions=st["preemptions"],
+                    prefix_hit_tokens=st["prefix_hit_tokens"],
+                    admitted_tokens=st["admitted_tokens"],
+                    **(self._radix.stats() if self._radix is not None
+                       else {}))
+            cached = (self._radix.block_count
+                      if self._radix is not None else 0)
+            self._alloc.check_leaks(expected_resident=cached)
+            if self._radix is not None:
+                self._radix.clear()
+            self._alloc.check_leaks(0)
         if self.telemetry is not None:
             self.telemetry.close()
 
@@ -481,8 +1007,14 @@ class ServingEngine:
         req.finish_time = time.perf_counter()
         if req.slot is not None:  # deadline-expired in queue: no slot yet
             del self._active[req.slot]
-            self._free.append(req.slot)
-            self._temps[req.slot] = 0.0  # idle slots tick greedy garbage
+            if self.paged:
+                # EVERY retirement path funnels here: the slot's blocks
+                # go back to the pool (or live on only through the radix
+                # cache's own reference) — close() asserts none leak
+                self._release_slot(req.slot)
+            else:
+                self._free.append(req.slot)
+                self._temps[req.slot] = 0.0  # idle slots tick greedy
         self._stats["completed"] += 1
         if reason == "deadline":
             self._stats["deadline_expired"] += 1
@@ -495,7 +1027,11 @@ class ServingEngine:
     def reset_stats(self) -> None:
         self._stats = dict(ticks=0, tick_s=0.0, prefills=0, prefill_s=0.0,
                            decode_tokens=0, occupancy_sum=0.0, completed=0,
-                           deadline_expired=0, ttft_s=[])
+                           deadline_expired=0, ttft_s=[],
+                           # paged-mode counters (stay 0 on dense)
+                           admissions=0, admitted_tokens=0,
+                           prefix_hit_tokens=0, prefill_chunks=0,
+                           preemptions=0, block_used_sum=0.0)
 
     @property
     def queue_depth(self) -> int:
@@ -504,6 +1040,13 @@ class ServingEngine:
     @property
     def active_count(self) -> int:
         return len(self._active)
+
+    @property
+    def prefilling_count(self) -> int:
+        """Admissions mid-chunked-prefill (0 or 1; always 0 dense) —
+        include it in any is-there-work-left check alongside queue_depth
+        and active_count."""
+        return int(self.paged and self._prefilling is not None)
 
     def summary(self) -> dict:
         """Aggregate serving metrics since the last reset_stats():
@@ -532,4 +1075,19 @@ class ServingEngine:
                 float(np.percentile(ttfts, 50)) * 1e3, 3)
             out["ttft_ms_p99"] = round(
                 float(np.percentile(ttfts, 99)) * 1e3, 3)
+        out["kv_hbm_bytes"] = self.kv_hbm_bytes
+        if self.paged:
+            out["block_size"] = self.block_size
+            out["num_blocks"] = self.num_blocks
+            out["prefill_chunks"] = st["prefill_chunks"]
+            out["preemptions"] = st["preemptions"]
+            out["block_utilization"] = (
+                round(st["block_used_sum"] / st["ticks"], 4)
+                if st["ticks"] else None)
+            out["prefix_hit_rate"] = (
+                round(st["prefix_hit_tokens"] / st["admitted_tokens"], 4)
+                if st["admitted_tokens"] else None)
+            out["prefix_hit_tokens"] = st["prefix_hit_tokens"]
+            if self._radix is not None:
+                out["prefix_cache"] = self._radix.stats()
         return out

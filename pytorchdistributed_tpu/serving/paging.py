@@ -1,0 +1,303 @@
+"""Host-side bookkeeping for the paged KV cache (ISSUE 7).
+
+The device half of paging lives in models/transformer.py (block pool +
+block-table gather inside the compiled tick) and serving/engine.py (the
+jitted paged tick / chunked prefill). Everything here is pure-Python
+state the scheduler mutates between compiled calls:
+
+  * `BlockAllocator` — a refcounted free list over the physical pool.
+    Block 0 is reserved as the TRASH block: retired slots' table entries
+    (and pad positions of chunked prefills) point at it, so their garbage
+    writes can never land in a block another request owns. A block frees
+    when its last reference drops — a slot's table entry and a radix-
+    cache node each hold one.
+  * `RadixPrefixCache` — a block-granularity radix tree over prompt
+    token ids (SGLang's RadixAttention at vLLM's block alignment): a
+    node caches ONE full block (`block_size` tokens) of K/V under its
+    parent's prefix. Admission walks the new prompt's full blocks down
+    the tree; every hit is admitted by *reference* (the slot's table
+    points at the cached physical block) instead of re-running prefill.
+    Only whole blocks are ever shared, and a slot's writes always land
+    in blocks it privately owns (its first unmatched block onward), so
+    the copy-on-write discipline holds by construction — divergence
+    within a block simply misses the cache and prefills a private copy.
+    Eviction is LRU over leaf nodes whose block the cache is the sole
+    owner of (evicting a block an active slot still reads would free
+    nothing and lose reuse).
+
+The leak invariant the engine asserts at teardown:
+``free + resident == usable`` — every non-trash block is either on the
+free list or accounted to at least one live reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over ``num_blocks`` physical KV
+    blocks of ``block_size`` tokens. Block 0 is the reserved trash block
+    and is never handed out."""
+
+    TRASH = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks {num_blocks} must be >= 2 (block 0 is the "
+                f"reserved trash block)")
+        if block_size < 1:
+            raise ValueError(f"block_size {block_size} must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # pop() hands out low block ids first (1, 2, ...)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._refs: dict[int, int] = {}
+
+    @property
+    def usable(self) -> int:
+        """Allocatable blocks (the pool minus the trash block)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def resident(self) -> int:
+        """Blocks currently referenced by at least one owner."""
+        return len(self._refs)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n fresh blocks at refcount 1, or None if the free list is
+        short (the caller decides: evict prefix cache, preempt, or
+        wait)."""
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._refs[b] = 1
+        return blocks
+
+    def incref(self, block: int) -> None:
+        if block == self.TRASH:
+            raise ValueError("cannot reference the trash block")
+        if block not in self._refs:
+            raise ValueError(f"block {block} is not allocated")
+        self._refs[block] += 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one reference; returns True when the block actually
+        freed back to the pool."""
+        rc = self._refs.get(block)
+        if rc is None:
+            raise ValueError(f"block {block} is not allocated")
+        if rc > 1:
+            self._refs[block] = rc - 1
+            return False
+        del self._refs[block]
+        self._free.append(block)
+        return True
+
+    def check_leaks(self, expected_resident: int = 0) -> None:
+        """The teardown invariant: free + resident == usable, and — once
+        every owner has released (slots retired, radix cleared) —
+        resident is exactly ``expected_resident``."""
+        if self.free_count + self.resident != self.usable:
+            raise AssertionError(
+                f"KV block leak: free {self.free_count} + resident "
+                f"{self.resident} != usable {self.usable} "
+                f"(held: {sorted(self._refs)})")
+        if self.resident != expected_resident:
+            raise AssertionError(
+                f"KV block leak: {self.resident} blocks still referenced "
+                f"at teardown (expected {expected_resident}): "
+                f"{sorted(self._refs)}")
+
+
+class _RadixNode:
+    __slots__ = ("children", "parent", "key", "block", "last_use")
+
+    def __init__(self, parent, key, block):
+        self.children: dict[tuple, _RadixNode] = {}
+        self.parent = parent
+        self.key = key
+        self.block = block
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Block-granularity radix tree mapping full-block token prefixes to
+    the physical pool blocks holding their K/V. Each node owns one
+    allocator reference on its block, so cached prefixes survive the
+    admitting request's retirement and free only on eviction."""
+
+    def __init__(self, allocator: BlockAllocator):
+        self.alloc = allocator
+        self._root = _RadixNode(None, None, None)
+        self._clock = itertools.count(1)
+        self._nodes = 0
+        # admission-level counters the engine folds into its summary
+        self.lookups = 0
+        self.hits = 0
+        self.lookup_tokens = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    @property
+    def block_count(self) -> int:
+        """Blocks the cache currently holds a reference on."""
+        return self._nodes
+
+    def _keys(self, tokens) -> list[tuple]:
+        bs = self.alloc.block_size
+        return [tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+                for i in range(len(tokens) // bs)]
+
+    def match(self, tokens) -> list[int]:
+        """Physical blocks backing the longest cached full-block prefix
+        of ``tokens`` (possibly empty). Does NOT take references — the
+        caller increfs the blocks it actually admits — and does NOT
+        count toward the hit-rate stats (a pool-starved admission
+        re-matches every retry; the engine records ONE
+        ``record_admission`` when the admission actually lands).
+        Touches the walked nodes' LRU clocks."""
+        node, out = self._root, []
+        for key in self._keys(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_use = next(self._clock)
+            out.append(child.block)
+            node = child
+        return out
+
+    def record_admission(self, matched_blocks: int,
+                         lookup_tokens: int) -> None:
+        """Fold one LANDED admission into the hit-rate counters."""
+        self.lookups += 1
+        self.lookup_tokens += lookup_tokens
+        if matched_blocks:
+            self.hits += 1
+            self.hit_tokens += matched_blocks * self.alloc.block_size
+
+    def insert(self, tokens, blocks) -> int:
+        """Register ``blocks`` as the cache entries for the full-block
+        prefix of ``tokens`` (``len(blocks)`` blocks' worth). Prefix
+        nodes that already exist keep their block (the caller was
+        admitted THROUGH them, so blocks[i] is the same physical id);
+        new nodes take one allocator reference each. Returns how many
+        new blocks were cached."""
+        node, added = self._root, 0
+        for key, block in zip(self._keys(tokens), blocks):
+            child = node.children.get(key)
+            if child is None:
+                self.alloc.incref(block)
+                child = _RadixNode(node, key, block)
+                node.children[key] = child
+                self._nodes += 1
+                added += 1
+            child.last_use = next(self._clock)
+            node = child
+        return added
+
+    def _evictable_leaves(self) -> list[_RadixNode]:
+        out = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif self.alloc.refcount(n.block) == 1:
+                # the cache is the sole owner: evicting actually frees
+                out.append(n)
+        return out
+
+    def evictable_count(self) -> int:
+        """How many blocks cascading leaf eviction could actually free:
+        sole-owner nodes whose entire subtree is sole-owner too (a
+        shared descendant pins its whole ancestor chain, since only
+        leaves ever drop). Lets the engine check feasibility BEFORE
+        destroying reusable prefixes on a reclaim that cannot cover the
+        allocation anyway."""
+        # iterative post-order (a full-length cached prompt is a chain
+        # max_seq_len/block_size deep — don't lean on the recursion
+        # limit): freeable(node) = all children freeable AND sole-owner
+        order: list[_RadixNode] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        total = 0
+        freeable: dict[int, bool] = {}
+        for n in reversed(order):  # children before parents
+            ok = (all(freeable[id(c)] for c in n.children.values())
+                  and self.alloc.refcount(n.block) == 1)
+            freeable[id(n)] = ok
+            total += ok
+        return total
+
+    def reclaim(self, n: int) -> int:
+        """Evict LRU sole-owner leaves until ``n`` blocks have freed (or
+        nothing evictable remains). Returns blocks actually freed."""
+        freed = 0
+        while freed < n:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            for leaf in sorted(leaves, key=lambda x: x.last_use):
+                if freed >= n:
+                    break
+                self._drop(leaf)
+                freed += 1
+        return freed
+
+    def _drop(self, node: _RadixNode) -> None:
+        del node.parent.children[node.key]
+        self.alloc.decref(node.block)
+        self._nodes -= 1
+        self.evictions += 1
+
+    def clear(self) -> int:
+        """Release every cached block (teardown / post-warmup flush)."""
+        freed = 0
+        stack = list(self._root.children.values())
+        order = []
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        for n in reversed(order):  # children before parents
+            self._drop(n)
+            freed += 1
+        return freed
+
+    def reset_stats(self) -> None:
+        """Zero the hit-rate counters (post-warmup flush) — cached
+        content and LRU state are untouched."""
+        self.lookups = self.hits = 0
+        self.lookup_tokens = self.hit_tokens = 0
+        self.evictions = 0
+
+    def stats(self) -> dict:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_rate": (round(self.hits / self.lookups, 4)
+                         if self.lookups else None),
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "token_hit_rate": (
+                round(self.hit_tokens / self.lookup_tokens, 4)
+                if self.lookup_tokens else None),
+            "cached_blocks": self._nodes,
+            "evictions": self.evictions,
+        }

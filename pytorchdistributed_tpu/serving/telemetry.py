@@ -56,7 +56,10 @@ class ServingTelemetry:
                             **row})
 
     def request(self, req) -> None:
-        """One completed-request row: TTFT + per-request decode rate."""
+        """One completed-request row: TTFT + per-request decode rate,
+        plus the paged-engine lifecycle (prefix-cache tokens admitted by
+        reference, prefill chunks paid, preempt round-trips — all 0 on
+        the dense engine)."""
         ttft = req.ttft_s
         self.metrics.write({
             "kind": "request", "time": round(time.time(), 3),
@@ -65,7 +68,17 @@ class ServingTelemetry:
             "finish_reason": req.finish_reason,
             "ttft_ms": None if ttft is None else round(ttft * 1e3, 3),
             "decode_tokens_per_s": req.decode_tokens_per_s,
+            "prefix_hit_tokens": getattr(req, "prefix_hit_tokens", 0),
+            "prefill_chunks": getattr(req, "prefill_chunks", 0),
+            "preemptions": getattr(req, "preemptions", 0),
         })
+
+    def pool(self, **row) -> None:
+        """One paged-pool summary row (engine close/summary time): the
+        prefix-cache hit counters + block utilization the report CLI's
+        serving table renders."""
+        self.metrics.write({"kind": "pool", "time": round(time.time(), 3),
+                            **row})
 
     def close(self) -> None:
         self.tracer.dump(os.path.join(

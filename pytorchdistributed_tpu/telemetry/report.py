@@ -189,6 +189,60 @@ def _layer_health_section(run_dir: str) -> list[str]:
     return lines
 
 
+def _serving_section(run_dir: str) -> list[str]:
+    """The serving / prefix-cache table (ISSUE 7): aggregate the
+    ``serve_metrics_rank*.jsonl`` streams a ServingEngine leaves behind —
+    per-request TTFT/hit rows plus the paged pool summary row close()
+    stamps. Silent (empty) when the run never served."""
+    from pytorchdistributed_tpu.serving.telemetry import SERVE_METRICS_GLOB
+
+    rows_by_rank = _read_rank_rows(run_dir, SERVE_METRICS_GLOB,
+                                   "serve_metrics_rank")
+    if not rows_by_rank:
+        return []
+    lines = ["serving (per rank: requests / TTFT / prefix cache):"]
+    lines.append(f"  {'rank':>4}  {'reqs':>5}  {'ttft p50':>9}  "
+                 f"{'hit tok':>8}  {'hit rate':>8}  {'chunks':>6}  "
+                 f"{'preempt':>7}  {'cached blk':>10}  {'kv hbm':>9}")
+    for rank, rows in sorted(rows_by_rank.items()):
+        reqs = [r for r in rows if r.get("kind") == "request"]
+        pool = next((r for r in reversed(rows)
+                     if r.get("kind") == "pool"), None)
+        ttfts = sorted(r["ttft_ms"] for r in reqs
+                       if r.get("ttft_ms") is not None)
+        p50 = (f"{ttfts[len(ttfts) // 2]:.1f} ms" if ttfts else "-")
+        hit_tok = sum(r.get("prefix_hit_tokens") or 0 for r in reqs)
+        # rate against ADMITTED tokens (the pool row counts every
+        # admission, preempt-resumes included — per-request prompt_len
+        # is counted once, so hit tokens accumulated across a request's
+        # re-admissions would read as > 100% sharing against it)
+        denom = (pool.get("admitted_tokens") if pool else None) or sum(
+            r.get("prompt_len") or 0 for r in reqs)
+        rate = f"{hit_tok / denom:.2%}" if denom else "-"
+        chunks = sum(r.get("prefill_chunks") or 0 for r in reqs)
+        preempt = sum(r.get("preemptions") or 0 for r in reqs)
+        cached = pool.get("cached_blocks", "-") if pool else "-"
+        hbm = _fmt_bytes(pool.get("kv_hbm_bytes")) if pool else "-"
+        lines.append(f"  {rank:>4}  {len(reqs):>5}  {p50:>9}  "
+                     f"{hit_tok:>8}  {rate:>8}  {chunks:>6}  "
+                     f"{preempt:>7}  {cached!s:>10}  {hbm:>9}")
+    pools = [r for rows in rows_by_rank.values() for r in rows
+             if r.get("kind") == "pool"]
+    if pools:
+        # pool geometry is per-engine (take any row); the cache counters
+        # sum across ranks so the line reads as the fleet's behavior
+        p = pools[-1]
+        hits = sum(r.get("hits") or 0 for r in pools)
+        lookups = sum(r.get("lookups") or 0 for r in pools)
+        evictions = sum(r.get("evictions") or 0 for r in pools)
+        lines.append(
+            f"  pool: {p.get('num_blocks', '-')} x "
+            f"{p.get('block_size', '-')}-token blocks, "
+            f"cache {hits}/{lookups} lookups hit, "
+            f"{evictions} evictions")
+    return lines
+
+
 def _device_trace_section(run_dir: str, top: int) -> list[str]:
     if not glob.glob(os.path.join(run_dir, "**", "*.trace.json.gz"),
                      recursive=True):
@@ -307,6 +361,12 @@ def render(run_dir: str | os.PathLike, *, top: int = 10) -> str:
     # -- layer health (in-graph diagnostics) --------------------------------
     lines.extend(_layer_health_section(run_dir))
     lines.append("")
+
+    # -- serving / prefix cache ---------------------------------------------
+    serving = _serving_section(run_dir)
+    if serving:
+        lines.extend(serving)
+        lines.append("")
 
     # -- host spans ----------------------------------------------------------
     if span_totals:

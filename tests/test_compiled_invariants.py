@@ -689,13 +689,16 @@ def test_decode_invariants():
 # donated cache.
 
 SERVING_NAMES = ("serve_tick", "serve_prefill", "serve_tick_int8fwd",
-                 "serve_prefill_int8fwd")
+                 "serve_prefill_int8fwd", "serve_tick_paged",
+                 "serve_prefill_paged")
 
 
 def serving_lowered(name: str):
     """Lower one serving program by pin name (shared with
     scripts/capture_invariants.py — the recapture ritual covers the
-    SERVING_NAMES)."""
+    SERVING_NAMES). The ``*_paged`` pair (ISSUE 7) lowers the paged
+    engine's steady-state programs — the pool-donated block-table tick
+    and the chunked prefill — at block 16 over a same-HBM pool."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -703,6 +706,9 @@ def serving_lowered(name: str):
     from pytorchdistributed_tpu.models import GPT2, gpt2_config
     from pytorchdistributed_tpu.serving.engine import (
         decode_tick,
+        paged_decode_tick,
+        paged_prefill_chunk,
+        paged_slot_models,
         prefill_into_slot,
         slot_models,
     )
@@ -710,7 +716,13 @@ def serving_lowered(name: str):
     slots, candidates, bucket = 4, 64, 128
     quant = "int8_fwd" if name.endswith("_int8fwd") else "none"
     model = GPT2(gpt2_config("test", quant=quant))
-    tick_model, prefill_model = slot_models(model, slots)
+    paged = name.endswith("_paged")
+    if paged:
+        block, pages = 16, model.cfg.max_seq_len // 16
+        tick_model, chunk_model = paged_slot_models(
+            model, slots, block, slots * pages + 1)
+    else:
+        tick_model, prefill_model = slot_models(model, slots)
     boxed = jax.eval_shape(model.init, jax.random.key(0),
                            jnp.zeros((1, 8), jnp.int32))
     weights_sds = nn.meta.unbox(boxed)["params"]
@@ -722,6 +734,25 @@ def serving_lowered(name: str):
     def sds(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype)
 
+    if name == "serve_prefill_paged":
+        return paged_prefill_chunk.lower(
+            chunk_model, weights_sds, cache_sds,
+            sds((1, bucket), i32),                       # prompt chunk
+            sds((), i32),                                # start
+            sds((tick_model.cfg.kv_pages,), i32),        # table row
+            sds((), i32),                                # true_len
+            sds(kd.shape, kd.dtype), sds((), i32),       # key, count
+            sds((), f32), sds((), i32), sds((), f32),    # sampling params
+            candidates=candidates)
+    if name == "serve_tick_paged":
+        return paged_decode_tick.lower(
+            tick_model, weights_sds, cache_sds,
+            sds((slots, tick_model.cfg.kv_pages), i32),  # block tables
+            sds((slots,), i32),                          # lengths
+            sds((slots,), i32),
+            sds((slots,) + kd.shape, kd.dtype), sds((slots,), i32),
+            sds((slots,), f32), sds((slots,), i32), sds((slots,), f32),
+            candidates=candidates)
     if name.startswith("serve_prefill"):
         return prefill_into_slot.lower(
             prefill_model, weights_sds, cache_sds,
@@ -800,6 +831,45 @@ SERVE_COMMITTED: dict[str, dict] = {
                         "all-to-all": 0, "ragged-all-to-all": 0,
                         "collective-broadcast": 0},
         "int8_ops": {"s8_values": 10, "int_dots": 5},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    # Paged engine (ISSUE 7), captured 2026-08-04 on this image:
+    # alias_bytes 270336 on the tick IS the donated block POOL
+    # ([33 blocks x 16 x 4 kv x 16] K+V bf16 x 2 layers = 270336 — the
+    # same-HBM pool at 4 slots x 8 pages + trash) — if it drops,
+    # donation broke and every tick copies the whole pool; the prefill
+    # chunk additionally aliases the counter/table scratch (270640).
+    # Zero collectives: paging is single-chip address arithmetic, a
+    # gather/scatter that partitions — an accidental collective in the
+    # tick is a per-token latency bug.
+    "serve_tick_paged": {
+        "flops": 1770077.0,
+        "temp_bytes": 969232,
+        "arg_bytes": 736512,
+        "alias_bytes": 270336,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    "serve_prefill_paged": {
+        "flops": 22510164.0,
+        "temp_bytes": 1885952,
+        "arg_bytes": 737136,
+        "alias_bytes": 270640,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
         "comm_bytes": {"all-reduce": 0, "all-gather": 0,
                        "reduce-scatter": 0, "collective-permute": 0,
                        "all-to-all": 0, "ragged-all-to-all": 0,

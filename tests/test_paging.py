@@ -1,0 +1,555 @@
+"""Paged KV cache tests (ISSUE 7: serving/paging.py + the paged engine).
+
+Correctness bar, same as the dense engine's (test_serving.py): for ANY
+admission order — now including prefix-cache hits, chunked prefills,
+block growth and preempt-requeue round-trips — greedy per-request
+outputs must be BITWISE-equal to inference.generate()'s. On top: the
+paged-attention kernel parity ladder (reference gather vs dense cache
+math at ragged/block-boundary lengths, fp32; the Pallas pool-native twin
+to online-softmax tolerance), the block allocator / radix-cache units,
+the every-exit-path block-leak invariant, and the zero-recompile
+steady-state guarantee over the paged program pair.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, Llama, gpt2_config
+from pytorchdistributed_tpu.models import llama_config
+from pytorchdistributed_tpu.ops.attention import paged_attention
+from pytorchdistributed_tpu.serving import (
+    BlockAllocator,
+    RadixPrefixCache,
+    ServingEngine,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.engine import (
+    paged_decode_tick,
+    paged_prefill_chunk,
+)
+
+
+def _init(model, seed=1):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 4), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# host bookkeeping units
+
+
+class TestBlockAllocator:
+    def test_alloc_free_refcount(self):
+        a = BlockAllocator(8, 4)
+        assert a.usable == 7 and a.free_count == 7
+        blocks = a.alloc(3)
+        assert blocks is not None and 0 not in blocks
+        assert a.free_count == 4 and a.resident == 3
+        a.incref(blocks[0])
+        assert not a.decref(blocks[0])  # still shared
+        assert a.decref(blocks[0])      # now freed
+        assert a.free_count == 5
+        assert a.alloc(6) is None       # over-ask leaves state untouched
+        assert a.free_count == 5
+        for b in blocks[1:]:
+            a.decref(b)
+        a.check_leaks(0)
+
+    def test_trash_block_reserved(self):
+        a = BlockAllocator(4, 2)
+        got = set(a.alloc(3))
+        assert 0 not in got
+        with pytest.raises(ValueError):
+            a.incref(0)
+
+    def test_leak_check_raises(self):
+        a = BlockAllocator(4, 2)
+        a.alloc(1)
+        with pytest.raises(AssertionError, match="leak"):
+            a.check_leaks(0)
+
+
+class TestRadixPrefixCache:
+    def test_match_insert_block_granularity(self):
+        a = BlockAllocator(16, 4)
+        r = RadixPrefixCache(a)
+        toks = np.arange(10, dtype=np.int32)  # 2 full blocks + tail
+        blocks = a.alloc(3)
+        assert r.match(toks) == []
+        r.insert(toks[:8], blocks[:2])        # only full blocks cached
+        assert r.block_count == 2
+        assert [a.refcount(b) for b in blocks[:2]] == [2, 2]
+        assert r.match(toks) == blocks[:2]
+        # divergence INSIDE the second block misses it (copy-on-write by
+        # construction: the divergent request prefills a private copy)
+        other = toks.copy()
+        other[5] = 99
+        assert r.match(other) == blocks[:1]
+
+    def test_reclaim_lru_sole_owner_only(self):
+        a = BlockAllocator(16, 4)
+        r = RadixPrefixCache(a)
+        b1 = a.alloc(2)
+        b2 = a.alloc(2)
+        r.insert(np.arange(8, dtype=np.int32), b1)
+        r.insert(np.arange(100, 108, dtype=np.int32), b2)
+        for b in b1 + b2:  # the admitting slots release their refs
+            a.decref(b)
+        # touch chain 1 -> chain 2's tail is the LRU evictable leaf
+        r.match(np.arange(8, dtype=np.int32))
+        free0 = a.free_count
+        assert r.reclaim(1) == 1
+        assert a.free_count == free0 + 1
+        assert r.match(np.arange(100, 108, dtype=np.int32)) == b2[:1]
+        # a block an active slot still holds is never reaped
+        a.incref(b1[1])
+        assert r.reclaim(10) >= 1  # everything sole-owner goes
+        assert a.refcount(b1[1]) >= 1
+        a.decref(b1[1])
+        r.clear()
+        a.check_leaks(0)
+
+
+# ---------------------------------------------------------------------------
+# paged attention parity ladder
+
+
+def _dense_decode_oracle(q, k_rows, v_rows, lengths):
+    """The dense cache-masked decode math, verbatim from the model's
+    dense branch (fp32 softmax, /sqrt(d) spelling)."""
+    attend = k_rows.shape[1]
+    pos = lengths[:, None] + jnp.arange(q.shape[1])
+    valid = jnp.arange(attend) <= pos[..., None]
+    scores = jnp.einsum("bihd,bjhd->bhij", q, k_rows,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.where(valid[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhij,bjhd->bihd", probs.astype(v_rows.dtype), v_rows,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _paged_fixture(lengths, *, bs=8, heads=4, kvh=2, d=16, seed=0):
+    """Build a pool + tables whose gathered content equals dense rows
+    holding the same K/V — the two layouts of one logical cache."""
+    rng = np.random.default_rng(seed)
+    slots = len(lengths)
+    mb = 8
+    attend = mb * bs
+    k_rows = rng.normal(size=(slots, attend, kvh, d)).astype(np.float32)
+    v_rows = rng.normal(size=(slots, attend, kvh, d)).astype(np.float32)
+    pool_k = np.zeros((slots * mb + 1, bs, kvh, d), np.float32)
+    pool_v = np.zeros_like(pool_k)
+    tables = np.zeros((slots, mb), np.int32)
+    nxt = 1
+    for s in range(slots):
+        for j in range(mb):
+            pool_k[nxt] = k_rows[s, j * bs:(j + 1) * bs]
+            pool_v[nxt] = v_rows[s, j * bs:(j + 1) * bs]
+            tables[s, j] = nxt
+            nxt += 1
+    q = rng.normal(size=(slots, 1, heads, d)).astype(np.float32)
+    rep = heads // kvh
+    k_full = np.repeat(k_rows, rep, axis=2)
+    v_full = np.repeat(v_rows, rep, axis=2)
+    return (jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(tables), jnp.asarray(np.asarray(lengths, np.int32)),
+            jnp.asarray(k_full), jnp.asarray(v_full))
+
+
+@pytest.mark.parametrize("lengths", [
+    (5, 17, 40),          # ragged
+    (16, 15, 17),         # block boundary: k*bs, k*bs - 1, k*bs + 1
+    (0, 63, 32),          # empty slot, last row, boundary
+])
+def test_paged_attention_bitwise_vs_dense(lengths):
+    """The gather layout is invisible to the math: paged attention over
+    a block pool is BITWISE-equal (fp32) to the dense cache path for
+    ragged and block-boundary (len == k*bs +/- 1) slot lengths."""
+    q, pk, pv, tbl, lens, kf, vf = _paged_fixture(lengths)
+    ref = _dense_decode_oracle(q, kf, vf, lens)
+    got = paged_attention(q, pk, pv, tbl, lens)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_paged_attention_trash_garbage_is_masked():
+    """Table entries past the live window point at the trash block; its
+    content must not perturb outputs (0-prob x finite garbage == 0)."""
+    q, pk, pv, tbl, lens, kf, vf = _paged_fixture((5, 9, 2))
+    ref = paged_attention(q, pk, pv, tbl, lens)
+    # poison the trash block and every block past each slot's window
+    pk = pk.at[0].set(1e6)
+    pv = pv.at[0].set(-1e6)
+    bs = pk.shape[1]
+    tbl_np = np.asarray(tbl).copy()
+    for s, n in enumerate((5, 9, 2)):
+        tbl_np[s, (n // bs) + 1:] = 0
+    got = paged_attention(q, pk, pv, jnp.asarray(tbl_np), lens)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_paged_flash_matches_reference():
+    """The Pallas pool-native twin (scalar-prefetched block tables, no
+    gathered HBM copy) matches the reference gather to online-softmax
+    tolerance, GQA included."""
+    from pytorchdistributed_tpu.ops.pallas_attention import (
+        paged_flash_attention,
+    )
+
+    q, pk, pv, tbl, lens, _, _ = _paged_fixture((5, 17, 40, 64), kvh=2)
+    ref = paged_attention(q, pk, pv, tbl, lens)
+    got = paged_flash_attention(q[:, 0], pk, pv, tbl, lens)
+    np.testing.assert_allclose(np.asarray(ref[:, 0]), np.asarray(got),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# the paged engine: parity, reuse, chunking, preemption, leaks
+
+
+def _mixed_requests(vocab, seed=0, n=5, lens=None, news=None):
+    rng = np.random.default_rng(seed)
+    lens = lens or [5, 9, 3, 13, 7, 11, 4, 8, 6][:n]
+    news = news or [6, 3, 8, 5, 4, 7, 2, 5, 3][:n]
+    prompts = [rng.integers(0, vocab, (m,)).astype(np.int32) for m in lens]
+    return prompts, news
+
+
+def _assert_paged_parity(model_cls, cfg, *, num_slots, lens=None,
+                         news=None, n=5, **engine_kw):
+    model = model_cls(cfg)
+    params = _init(model)
+    dm = model_cls(dataclasses.replace(cfg, decode=True))
+    prompts, news = _mixed_requests(cfg.vocab_size, n=n, lens=lens,
+                                    news=news)
+    engine = ServingEngine(model, params, num_slots=num_slots,
+                           prefill_bucket=16, block_size=8, **engine_kw)
+    assert engine.paged
+    engine.warmup(prompt_lens=(8, 16))
+    reqs = []
+    for p, n_new in zip(prompts, news):
+        reqs.append(engine.submit(p, max_new_tokens=n_new))
+        engine.step()  # staggered arrivals interleave with decoding
+    engine.run_until_idle()
+    for p, n_new, r in zip(prompts, news, reqs):
+        ref = generate(dm, params, jnp.asarray(p)[None],
+                       max_new_tokens=n_new)
+        np.testing.assert_array_equal(
+            r.output_ids, np.asarray(ref)[0],
+            err_msg=f"request {r.id} (preemptions={r.preemptions})")
+    engine.close()  # the leak invariant runs on every parity drive
+    return reqs
+
+
+def test_parity_paged_engine():
+    """The ISSUE 7 acceptance anchor: greedy paged-engine outputs are
+    bitwise-equal to generate() for a staggered mixed-length admission
+    order (chunked prefill + block growth on every request)."""
+    _assert_paged_parity(GPT2, gpt2_config("test", num_layers=2,
+                                           max_seq_len=64),
+                         num_slots=3, n=5)
+
+
+def test_parity_paged_block_boundary_lengths():
+    """Prompt lengths straddling the block grid (k*bs - 1, k*bs,
+    k*bs + 1 at bs=8) — the partial-tail-block and exact-boundary write
+    paths — plus generations that cross block boundaries mid-decode."""
+    _assert_paged_parity(GPT2, gpt2_config("test", num_layers=2,
+                                           max_seq_len=64),
+                         num_slots=3, lens=[7, 8, 9, 16, 17],
+                         news=[9, 8, 7, 6, 5], n=5)
+
+
+def test_parity_paged_llama_gqa():
+    """Per-row RoPE offsets + grouped-query heads through the pool
+    scatter/gather layout."""
+    _assert_paged_parity(Llama, llama_config("test", max_seq_len=64),
+                         num_slots=2, n=4)
+
+
+def test_parity_paged_int8():
+    """--quant int8_fwd composes with paging: the chunk/tick run the
+    same quantized projections, outputs bitwise-equal to quantized
+    generate()."""
+    _assert_paged_parity(GPT2, gpt2_config("test", num_layers=2,
+                                           max_seq_len=64,
+                                           quant="int8_fwd"),
+                         num_slots=2, n=3)
+
+
+def test_parity_paged_unrolled_layers():
+    """scan_layers=False: per-layer (unstacked) pool/table leaves ride
+    the same name-based override plumbing."""
+    _assert_paged_parity(GPT2, gpt2_config("test", num_layers=2,
+                                           max_seq_len=64,
+                                           scan_layers=False),
+                         num_slots=2, n=3)
+
+
+def test_prefix_reuse_hits_and_parity():
+    """Shared-system-prompt admissions reuse cached blocks (hit tokens
+    > 0, fewer prefill chunks) and stay bitwise-equal: reused K/V is
+    bit-identical to recomputed K/V."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    rng = np.random.default_rng(2)
+    system = rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32)
+    engine = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                           block_size=8, prefill_chunk=16)
+    engine.warmup(prompt_lens=(16, 48))
+    reqs = []
+    for i in range(3):
+        tail = rng.integers(0, cfg.vocab_size, (5 + i,)).astype(np.int32)
+        p = np.concatenate([system, tail])
+        reqs.append((p, engine.submit(p, max_new_tokens=5)))
+        engine.run_until_idle()  # serialize so each later one can hit
+    first, later = reqs[0][1], [r for _, r in reqs[1:]]
+    assert first.prefix_hit_tokens == 0
+    assert all(r.prefix_hit_tokens >= 40 - 8 for r in later)
+    assert all(r.prefill_chunks < first.prefill_chunks for r in later)
+    for p, r in reqs:
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=5)
+        np.testing.assert_array_equal(r.output_ids, np.asarray(ref)[0])
+    s = engine.summary()
+    assert s["prefix_hit_rate"] > 0
+    assert s["prefix_cache"]["hits"] == 2
+    engine.close()
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A long admission must not head-of-line-block resident streams:
+    while request B's prompt prefills chunk by chunk, resident request A
+    keeps receiving one token per step."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=2,
+                           prefill_bucket=16, block_size=8,
+                           prefill_chunk=16)
+    engine.warmup(prompt_lens=(16, 64))
+    rng = np.random.default_rng(4)
+    a = engine.submit(rng.integers(0, cfg.vocab_size, (5,)),
+                      max_new_tokens=20)
+    engine.step()
+    assert len(a.new_tokens) >= 1
+    # 60-token prompt = 4 chunks of 16: admission spans multiple steps
+    b = engine.submit(rng.integers(0, cfg.vocab_size, (60,)),
+                      max_new_tokens=4)
+    deliveries = []
+    while b.slot is None and not b.done:
+        before = len(a.new_tokens)
+        engine.step()
+        deliveries.append(len(a.new_tokens) - before)
+    assert len(deliveries) >= 3  # the admission really was chunked
+    assert all(d == 1 for d in deliveries[:-1]), (
+        f"resident stream starved during chunked prefill: {deliveries}")
+    engine.run_until_idle()
+    assert a.finish_reason == "length" and b.finish_reason == "length"
+    engine.close()
+
+
+def test_run_until_idle_finishes_stranded_prefill():
+    """Regression: a resident stream retiring on the very step a
+    neighbor's chunked prefill is mid-flight used to leave queue and
+    slots empty with the admission stranded — run_until_idle must keep
+    stepping until the in-flight prefill completes too."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=2,
+                           prefill_bucket=16, block_size=8,
+                           prefill_chunk=16)
+    engine.warmup(prompt_lens=(16, 64))
+    rng = np.random.default_rng(6)
+    a = engine.submit(rng.integers(0, cfg.vocab_size, (5,)),
+                      max_new_tokens=3)
+    engine.step()  # admission + tick deliver 2: one-token budget left
+    b = engine.submit(rng.integers(0, cfg.vocab_size, (60,)),
+                      max_new_tokens=3)
+    engine.step()  # chunk 1 of b + a's final token: a retires here
+    assert a.done and not b.done and engine.prefilling_count == 1
+    assert engine.active_count == 0 and engine.queue_depth == 0
+    engine.run_until_idle()
+    assert b.done and b.finish_reason == "length"
+    assert len(b.new_tokens) == 3
+    engine.close()
+
+
+def test_preemption_requeues_and_stays_bitwise():
+    """A pool too small for the offered load preempts the youngest
+    resident (blocks freed, request requeued); its continuation resumes
+    by re-prefilling prompt + generated — every request's final output
+    stays bitwise-equal to generate(), and nothing retraces."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16,
+                           block_size=8, num_blocks=21, prefill_chunk=16)
+    engine.warmup(prompt_lens=(16,))
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    rng = np.random.default_rng(0)
+    ps, rs = [], []
+    for i in range(4):
+        p = rng.integers(0, cfg.vocab_size, (20 + 7 * i,)).astype(np.int32)
+        ps.append(p)
+        rs.append(engine.submit(p, max_new_tokens=30))
+        engine.step()
+    engine.run_until_idle()
+    assert sum(r.preemptions for r in rs) >= 1, "pool never pressured"
+    assert engine.summary()["preemptions"] >= 1
+    for p, r in zip(ps, rs):
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=30)
+        np.testing.assert_array_equal(
+            r.output_ids, np.asarray(ref)[0],
+            err_msg=f"request {r.id} (preemptions={r.preemptions})")
+    assert dict(serving_engine.TRACE_COUNTS) == traces0
+    engine.close()
+
+
+def test_blocks_freed_on_every_exit_path(tmp_path):
+    """The ISSUE 7 leak satellite: stop-id retirement, budget
+    retirement, deadline expiry (queued / resident / MID-PREFILL) and
+    the SIGTERM drain all return their blocks — the pool invariant
+    (free + resident == usable) holds mid-run and close()'s teardown
+    assertion passes with only radix-cached blocks resident."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=2,
+                           prefill_bucket=16, block_size=8,
+                           prefill_chunk=16, telemetry_dir=str(tmp_path))
+    engine.warmup(prompt_lens=(16, 64))
+    rng = np.random.default_rng(1)
+
+    def pool_consistent():
+        a = engine._alloc
+        assert a.free_count + a.resident == a.usable
+
+    # budget ("length") + stop-id retirement
+    r1 = engine.submit(rng.integers(0, cfg.vocab_size, (5,)),
+                       max_new_tokens=3)
+    engine.run_until_idle()
+    stop = r1.new_tokens[0]
+    r2 = engine.submit(rng.integers(0, cfg.vocab_size, (5,)),
+                       max_new_tokens=50, stop_ids=(stop, 10 ** 6))
+    engine.run_until_idle()
+    pool_consistent()
+    # deadline on queue (no blocks ever allocated) and mid-decode
+    r3 = engine.submit(rng.integers(0, cfg.vocab_size, (6,)),
+                       max_new_tokens=40, deadline_s=60.0)
+    engine.step()
+    assert r3.slot is not None
+    r3.submit_time -= 120.0
+    engine.step()
+    assert r3.finish_reason == "deadline"
+    pool_consistent()
+    # deadline mid-chunked-prefill: blocks allocated, never decoded (a
+    # resident stream keeps the admission chunked across steps)
+    r5 = engine.submit(rng.integers(0, cfg.vocab_size, (5,)),
+                       max_new_tokens=40)
+    engine.step()
+    r4 = engine.submit(rng.integers(0, cfg.vocab_size, (60,)),
+                       max_new_tokens=4, deadline_s=60.0)
+    engine.step()
+    assert r4.slot is None and engine._prefilling is not None
+    r4.submit_time -= 120.0
+    engine.step()
+    assert r4.finish_reason == "deadline" and engine._prefilling is None
+    pool_consistent()
+    # SIGTERM drain: the mid-stream resident + a queued request both shed
+    r6 = engine.submit(rng.integers(0, cfg.vocab_size, (90,)),
+                       max_new_tokens=4)
+    engine.request_drain()
+    engine.step()
+    assert r5.finish_reason == "drained" and r6.finish_reason == "drained"
+    assert 0 < len(r5.new_tokens) < 40
+    pool_consistent()
+    engine.close()  # asserts free + resident == pool, radix-only residue
+    rows = [json.loads(x) for x in
+            (tmp_path / "serve_metrics_rank0.jsonl")
+            .read_text().strip().splitlines()]
+    reasons = [r["finish_reason"] for r in rows if r["kind"] == "request"]
+    assert reasons.count("deadline") == 2
+    assert reasons.count("drained") == 2
+    assert any(r["kind"] == "pool" for r in rows)
+
+
+def test_zero_recompiles_steady_state_paged():
+    """After warmup, a mixed paged load — any in-bucket prompt length,
+    prefix hits AND misses, block growth, retire + readmit — triggers
+    ZERO retraces and zero recompiles of the paged tick/chunk pair."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=3,
+                           prefill_bucket=16, block_size=8)
+    engine.warmup(prompt_lens=(8, 16))
+    traces = dict(serving_engine.TRACE_COUNTS)
+    sizes = (paged_prefill_chunk._cache_size(),
+             paged_decode_tick._cache_size())
+    rng = np.random.default_rng(3)
+    shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    for i in range(8):
+        if i % 3 == 0:  # prefix-cache hits exercise the reuse path
+            p = np.concatenate([shared, rng.integers(
+                0, cfg.vocab_size, (int(rng.integers(1, 8)),))]).astype(
+                    np.int32)
+        else:
+            p = rng.integers(0, cfg.vocab_size,
+                             (int(rng.integers(1, 16)),)).astype(np.int32)
+        engine.submit(p, max_new_tokens=int(rng.integers(1, 6)))
+        engine.step()
+    engine.run_until_idle()
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    assert (paged_prefill_chunk._cache_size(),
+            paged_decode_tick._cache_size()) == sizes
+    engine.close()
+
+
+def test_report_cli_renders_serving_table(tmp_path):
+    """The telemetry report CLI grows a serving / prefix-cache section
+    from the serve_metrics JSONL (ISSUE 7 satellite)."""
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=2,
+                           prefill_bucket=16, block_size=8,
+                           telemetry_dir=str(tmp_path))
+    engine.warmup(prompt_lens=(16,))
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, cfg.vocab_size, (20,)).astype(np.int32)
+    engine.submit(p, max_new_tokens=4)
+    engine.run_until_idle()
+    engine.submit(p, max_new_tokens=4)  # guaranteed prefix hit
+    engine.run_until_idle()
+    engine.close()
+    out = render(tmp_path)
+    assert "serving (per rank" in out
+    assert "prefix cache" in out
+    assert "-token blocks" in out
+    # the hit tokens column is non-zero: reuse reached the report
+    import re
+    m = re.search(r"^\s+0\s+\d+\s+\S+ ms\s+(\d+)", out, re.M)
+    assert m and int(m.group(1)) > 0, out
+
+
+def test_paged_validations():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    with pytest.raises(ValueError, match="divide"):
+        ServingEngine(model, params, num_slots=2, block_size=7)
+    with pytest.raises(ValueError, match="full-context"):
+        ServingEngine(model, params, num_slots=2, block_size=8,
+                      num_blocks=4)
+    from pytorchdistributed_tpu.models.transformer import TransformerConfig
+    with pytest.raises(ValueError, match="decode"):
+        TransformerConfig(kv_block_size=8, kv_blocks=4)
+    with pytest.raises(ValueError, match="multiple"):
+        TransformerConfig(decode=True, decode_slots=2, kv_block_size=7,
+                          kv_blocks=4, max_seq_len=64)

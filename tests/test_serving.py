@@ -133,6 +133,34 @@ def test_parity_on_tp_mesh():
                    params=sharded, ref_params=params)
 
 
+def test_parity_paged_on_dp_mesh():
+    """Sharding composes with paging (ISSUE 7): the PAGED engine under a
+    data mesh — replicated params, host-stamped block tables entering
+    the compiled tick as dynamic args — emits exactly the dense
+    engine's / generate()'s tokens. (The single-host paged parity
+    ladder lives in tests/test_paging.py, quick tier.)"""
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    prompts, news = _mixed_requests(cfg.vocab_size, n=4)
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16,
+                           block_size=8, mesh=create_mesh(data=8))
+    engine.warmup(prompt_lens=(8, 16))
+    reqs = []
+    for p, n in zip(prompts, news):
+        reqs.append(engine.submit(p, max_new_tokens=n))
+        engine.step()
+    engine.run_until_idle()
+    for p, n, r in zip(prompts, news, reqs):
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=n)
+        np.testing.assert_array_equal(r.output_ids, np.asarray(ref)[0],
+                                      err_msg=f"request {r.id}")
+    engine.close()
+
+
 def test_retirement_readmission_stress():
     """More requests than slots: every slot retires and readmits several
     times (fresh prefill must fully overwrite the previous tenant's rows
