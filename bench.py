@@ -729,6 +729,55 @@ def _serve_prefix_ab(block_size: int) -> dict:
     return out
 
 
+def _serve_spec_ab(block_size: int, spec_k: int) -> dict:
+    """The ISSUE 8 claim, measured: the same greedy trace served with
+    speculative decoding ON (self-drafted — the draft IS the target, so
+    acceptance is ~1 and the stamp isolates the MECHANISM's ceiling:
+    tokens_per_target_forward ≈ spec_k+1, bounded below by budget-
+    truncated final rounds) vs OFF. On hardware the memory-bound target
+    makes tokens/forward the decode-rate multiplier; on CPU-sim the
+    tokens/s twin is stamped but the acceptance / tokens-per-forward
+    pair is the portable number."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import ServingEngine
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=512,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(13)
+    n = 16
+    lens = rng.integers(16, 97, n)
+    prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+               for m in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / 64.0, n))
+
+    out = {}
+    for name, k in (("spec_off", 0), ("spec_on", spec_k)):
+        engine = ServingEngine(model, params, num_slots=4,
+                               prefill_bucket=128, block_size=block_size,
+                               spec_k=k)
+        engine.warmup(prompt_lens=(128,))
+        s, _ = _drive_serve_trace(engine, prompts, arrivals, 48)
+        engine.close()
+        out[name] = {
+            "decode_tokens_per_s": s["decode_tokens_per_s"],
+            "acceptance_rate": s.get("acceptance_rate"),
+            "tokens_per_target_forward": s.get("tokens_per_target_forward",
+                                               1.0),
+        }
+    on, off = out["spec_on"], out["spec_off"]
+    out["spec_k"] = spec_k
+    if on["decode_tokens_per_s"] and off["decode_tokens_per_s"]:
+        out["decode_tokens_per_s_speedup"] = round(
+            on["decode_tokens_per_s"] / off["decode_tokens_per_s"], 3)
+    return out
+
+
 def bench_serve() -> dict:
     """Continuous-batching serving (serving/ServingEngine) under a
     synthetic Poisson arrival trace: seeded exponential inter-arrivals at
@@ -743,12 +792,19 @@ def bench_serve() -> dict:
     the main trace on the PAGED engine (block-table KV + radix prefix
     cache + chunked prefill, ISSUE 7) and stamps kv_hbm_bytes /
     block_utilization / prefix_hit_rate / prefill_chunks next to the
-    usual numbers; the record always carries the two paged A/Bs —
-    ``paged_capacity`` (>= 2x resident slots at the same HBM budget) and
-    ``prefix_ab`` (shared-system-prompt TTFT with reuse on vs off) —
-    unless PTD_SERVE_AB=0. Runs on CPU-sim or TPU unchanged; knobs via
-    env: PTD_SERVE_SIZE/SLOTS/REQUESTS/RATE/MAX_NEW/PAGED/BLOCK,
-    PTD_QUANT rides the model config like the training benches."""
+    usual numbers; PTD_SERVE_SPEC=1 additionally serves it with
+    SPECULATIVE decoding (ISSUE 8, self-drafted, k = PTD_SPEC_K,
+    implies paged) and stamps acceptance_rate /
+    tokens_per_target_forward. The record always carries the paged A/Bs
+    — ``paged_capacity`` (>= 2x resident slots at the same HBM budget)
+    and ``prefix_ab`` (shared-system-prompt TTFT with reuse on vs off) —
+    plus the ``spec_ab`` twin (spec on vs off on the self-drafted
+    trace). PTD_SERVE_AB=0 skips ALL of them; PTD_SPEC_AB=0 skips just
+    spec_ab. Runs on
+    CPU-sim or TPU unchanged; knobs via env:
+    PTD_SERVE_SIZE/SLOTS/REQUESTS/RATE/MAX_NEW/PAGED/BLOCK/SPEC,
+    PTD_SPEC_K, PTD_QUANT rides the model config like the training
+    benches."""
     import os
 
     import jax
@@ -763,14 +819,17 @@ def bench_serve() -> dict:
     n_requests = int(os.environ.get("PTD_SERVE_REQUESTS", "32"))
     rate = float(os.environ.get("PTD_SERVE_RATE", "8.0"))
     max_new = int(os.environ.get("PTD_SERVE_MAX_NEW", "32"))
-    paged = os.environ.get("PTD_SERVE_PAGED", "0") == "1"
+    spec = os.environ.get("PTD_SERVE_SPEC", "0") == "1"
+    spec_k = int(os.environ.get("PTD_SPEC_K", "4"))
+    paged = spec or os.environ.get("PTD_SERVE_PAGED", "0") == "1"
     block = int(os.environ.get("PTD_SERVE_BLOCK", "16"))
     cfg = gpt2_config(size, scan_layers=False, quant=_quant_override())
     params = jax.jit(GPT2(cfg).init)(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
     engine = ServingEngine(GPT2(cfg), params, num_slots=num_slots,
                            prefill_bucket=128,
-                           block_size=block if paged else 0)
+                           block_size=block if paged else 0,
+                           spec_k=spec_k if spec else 0)
 
     rng = np.random.default_rng(0)
     lens = rng.integers(16, 97, n_requests)
@@ -801,15 +860,24 @@ def bench_serve() -> dict:
         result["prefix_hit_rate"] = s["prefix_hit_rate"]
         result["prefill_chunks"] = s["prefill_chunks"]
         result["preemptions"] = s["preemptions"]
+    if spec:
+        result["spec_k"] = spec_k
+        result["acceptance_rate"] = s["acceptance_rate"]
+        result["tokens_per_target_forward"] = s["tokens_per_target_forward"]
     engine.close()
+    # PTD_SERVE_AB=0 is the master fast-path switch for ALL serving
+    # A/Bs; PTD_SPEC_AB=0 skips just the speculative one
     if os.environ.get("PTD_SERVE_AB", "1") != "0":
         result["paged_capacity"] = _serve_capacity_ab(block)
         result["prefix_ab"] = _serve_prefix_ab(block)
+        if os.environ.get("PTD_SPEC_AB", "1") != "0":
+            result["spec_ab"] = _serve_spec_ab(block, spec_k)
     _stamp_overrides(result, ("PTD_SERVE_SIZE", "PTD_SERVE_SLOTS",
                               "PTD_SERVE_REQUESTS", "PTD_SERVE_RATE",
                               "PTD_SERVE_MAX_NEW", "PTD_SERVE_PAGED",
                               "PTD_SERVE_BLOCK", "PTD_SERVE_AB",
-                              "PTD_QUANT"))
+                              "PTD_SERVE_SPEC", "PTD_SPEC_K",
+                              "PTD_SPEC_AB", "PTD_QUANT"))
     return result
 
 

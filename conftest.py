@@ -151,6 +151,14 @@ _QUICK = (
     # serving table — all on test-size models. The paged HLO pins ride
     # the already-quick test_serving_invariants parametrization.
     "test_paging.py",
+    # speculative decoding (ISSUE 8): the whole file is quick-tier by
+    # design — rejection-kernel units + the chi-squared losslessness
+    # check, offline generate_speculative bitwise parity (self-draft,
+    # truncated draft, int8, GQA/RoPE, stop ids), and the serving
+    # engine's spec tick (greedy parity incl. prefix hits + preemption,
+    # seeded determinism, zero recompiles, telemetry columns) — all on
+    # test-size models. The spec HLO pin rides test_serving_invariants.
+    "test_spec.py",
 )
 
 

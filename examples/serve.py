@@ -40,7 +40,20 @@ def main():
                         help="> 0: serve from the paged KV engine "
                              "(block-table pool + radix prefix reuse + "
                              "chunked prefill; README 'Paged KV cache')")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="> 0: speculative decoding — a draft model "
+                             "proposes this many tokens per target "
+                             "forward, losslessly verified (README "
+                             "'Speculative decoding'; implies the paged "
+                             "engine, default block size 16)")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="with --spec-k: build the draft by "
+                             "truncating the trained model to its first "
+                             "N layers (0 = self-draft with the full "
+                             "model, acceptance ~1)")
     args = parser.parse_args()
+    if args.spec_k and not args.block_size:
+        args.block_size = 16  # spec requires the paged engine
 
     ptd.init_process_group()
     cfg = llama_config("test", max_seq_len=64)
@@ -59,10 +72,18 @@ def main():
         float(metrics["loss"])  # force the async dispatch each step
     print(f"trained {args.steps} steps, loss {float(metrics['loss']):.4f}")
 
+    params = {"params": trainer.state.params["params"]}
+    spec_kw = {}
+    if args.spec_k and args.draft_layers:
+        from pytorchdistributed_tpu.inference import truncated_draft
+
+        draft, draft_params = truncated_draft(model, params,
+                                              args.draft_layers)
+        spec_kw = dict(draft_config=draft.cfg, draft_params=draft_params)
     engine = ServingEngine(
-        model, {"params": trainer.state.params["params"]},
+        model, params,
         num_slots=args.num_slots, prefill_bucket=16,
-        block_size=args.block_size,
+        block_size=args.block_size, spec_k=args.spec_k, **spec_kw,
         telemetry_dir=args.telemetry_dir)
     engine.warmup(prompt_lens=(16,))
 

@@ -61,4 +61,6 @@ from pytorchdistributed_tpu.runtime.dist import (  # noqa: F401
 from pytorchdistributed_tpu.inference import (  # noqa: F401
     generate,
     generate_bucketed,
+    generate_speculative,
+    truncated_draft,
 )

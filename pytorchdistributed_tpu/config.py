@@ -99,6 +99,17 @@ class ExperimentConfig:
     # the same compiled step. Empty = unset, so the PTD_DIAGNOSTICS env
     # contract (run.py workers) still applies; any explicit value wins.
     diagnostics: str = ""
+    # Speculative decoding for the serving path (serving/engine.py,
+    # ISSUE 8): spec_k > 0 makes every decode tick draft-and-verify that
+    # many tokens per target forward (lossless rejection sampling —
+    # greedy output bitwise-equal, sampled distribution-equal).
+    # draft_layers > 0 builds the draft by truncating the served model
+    # to its first N layers (inference.truncated_draft); 0 self-drafts
+    # with the full model. Serving-only knobs: training ignores them
+    # (examples/serve.py --spec-k/--draft-layers and bench.py
+    # PTD_SERVE_SPEC/PTD_SPEC_K consume the same pair).
+    spec_k: int = 0
+    draft_layers: int = 0
 
 
 # The five BASELINE.json benchmark configs, smallest to largest.

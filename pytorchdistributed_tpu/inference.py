@@ -110,6 +110,28 @@ def _sample(logits, key, *, temperature: float, top_k: int | None,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _slot_candidates(logits, temperature, top_k, top_p, candidates: int):
+    """The shared per-row candidate filter behind ``sample_slots`` and
+    ``slot_filtered_probs``: top-``candidates`` logits per row, rank-masked
+    by the dynamic per-row top_k, temperature-scaled, nucleus-masked
+    (drop candidates once the cumulative probability BEFORE them reaches
+    p — the first candidate always survives, same rule as _sample).
+    Returns ``(vals, idxs)``: [n, c] filtered/scaled logits (-inf at
+    dropped candidates) and their vocab ids. One function so the sampler
+    and the speculative-decoding probability vectors can never drift
+    apart — losslessness of the rejection kernel depends on q/p being
+    EXACTLY the distributions the sampler draws from."""
+    c = min(candidates, logits.shape[-1])
+    vals, idxs = lax.top_k(logits, c)            # [n, c] descending
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, c), c)
+    vals = jnp.where(jnp.arange(c)[None, :] < k[:, None], vals, -jnp.inf)
+    vals = vals / jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    vals = jnp.where(cum >= top_p[:, None], -jnp.inf, vals)
+    return vals, idxs
+
+
 def sample_slots(logits, keys, temperature, top_k, top_p, *,
                  candidates: int = 64):
     """Per-row sampling over ``[n, vocab]`` fp32 logits where every row
@@ -127,20 +149,89 @@ def sample_slots(logits, keys, temperature, top_k, top_p, *,
     Greedy rows take idxs[:, 0] == argmax (lax.top_k is index-stable), so
     a temperature-0 row is bitwise `jnp.argmax` — the parity property the
     serving tests pin against generate()."""
-    c = min(candidates, logits.shape[-1])
-    vals, idxs = lax.top_k(logits, c)            # [n, c] descending
+    vals, idxs = _slot_candidates(logits, temperature, top_k, top_p,
+                                  candidates)
     greedy = idxs[:, 0]
-    k = jnp.where(top_k > 0, jnp.minimum(top_k, c), c)
-    vals = jnp.where(jnp.arange(c)[None, :] < k[:, None], vals, -jnp.inf)
-    vals = vals / jnp.maximum(temperature, 1e-6)[:, None]
-    # nucleus: drop candidates once the cumulative probability BEFORE them
-    # reaches p (first candidate always survives) — same rule as _sample
-    probs = jax.nn.softmax(vals, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1) - probs
-    vals = jnp.where(cum >= top_p[:, None], -jnp.inf, vals)
     choice = jax.vmap(jax.random.categorical)(keys, vals)
     sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def slot_filtered_probs(logits, temperature, top_k, top_p, *,
+                        candidates: int = 64):
+    """Full-vocab probability vectors ``[n, vocab]`` of the EXACT per-row
+    distribution ``sample_slots`` draws from (same candidate filter, same
+    renormalization — they share `_slot_candidates`). Greedy rows
+    (temperature <= 0) return an exact one-hot at idxs[:, 0] == argmax,
+    so rejection sampling against these vectors degenerates to
+    accept-iff-argmax-matches — the bitwise-greedy property the
+    speculative tests pin. The speculative decoder's q (draft) and p
+    (target) are both computed here."""
+    n, v = logits.shape
+    vals, idxs = _slot_candidates(logits, temperature, top_k, top_p,
+                                  candidates)
+    probs = jax.nn.softmax(vals, axis=-1)        # 0 at dropped candidates
+    rows = jnp.arange(n)[:, None]
+    full = jnp.zeros((n, v), jnp.float32).at[rows, idxs].set(probs)
+    onehot = jnp.zeros((n, v), jnp.float32).at[
+        jnp.arange(n), idxs[:, 0]].set(1.0)
+    return jnp.where((temperature <= 0.0)[:, None], onehot, full)
+
+
+def speculative_accept(draft_tokens, q_probs, p_probs, unif, res_keys,
+                       greedy):
+    """Vectorized lossless rejection sampling (Leviathan et al. 2023;
+    Chen et al. 2023): decide, per row, how many draft proposals the
+    target model keeps, and sample the one correction/bonus token that
+    follows — the emitted tokens are distributed EXACTLY as if the target
+    had sampled them one by one.
+
+      draft_tokens: [n, k] draft proposals.
+      q_probs:      [n, k, vocab] the draft distributions each proposal
+        was sampled from (slot_filtered_probs of the draft logits).
+      p_probs:      [n, k+1, vocab] target distributions at every
+        position of the verify forward (position i scores the token
+        AFTER draft_tokens[:, :i]).
+      unif:         [n, k] uniforms in [0, 1) — the accept coin flips.
+      res_keys:     [n] PRNG keys for the residual/bonus sample.
+      greedy:       [n] bool — rows whose correction must be the exact
+        argmax (their p/q are one-hots, so acceptance is deterministic
+        and no randomness is consumed).
+
+    Proposal i is accepted with probability min(1, p_i(x_i)/q_i(x_i));
+    the first rejection at position i resamples from the residual
+    norm(max(p_i - q_i, 0)), and a fully-accepted row draws a BONUS
+    token from p_{k+1} — the q=0 degenerate of the same residual formula.
+    Returns ``(tokens [n, k+1], n_accept [n])``: tokens[:, :n_accept] are
+    the kept proposals and tokens[:, n_accept] the correction/bonus; the
+    caller reads exactly n_accept+1 tokens per row (later positions hold
+    leftover proposals)."""
+    n, k = draft_tokens.shape
+    rows = jnp.arange(n)
+    p_at = jnp.take_along_axis(
+        p_probs[:, :k], draft_tokens[..., None], axis=-1)[..., 0]
+    q_at = jnp.take_along_axis(
+        q_probs, draft_tokens[..., None], axis=-1)[..., 0]
+    # u < min(1, p/q)  <=>  u*q < p for u in [0,1): no division, and the
+    # greedy one-hot case stays exact (q_at == 1.0 exactly)
+    accept = unif * q_at < p_at                              # [n, k]
+    n_accept = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+    p_cut = p_probs[rows, n_accept]                          # [n, vocab]
+    q_cut = jnp.where((n_accept < k)[:, None],
+                      q_probs[rows, jnp.minimum(n_accept, k - 1)], 0.0)
+    res = jnp.maximum(p_cut - q_cut, 0.0)
+    tot = res.sum(axis=-1, keepdims=True)
+    # a rejection with p <= q everywhere is impossible in exact math but
+    # can appear under fp rounding: fall back to the target distribution
+    res = jnp.where(tot > 0, res / jnp.where(tot > 0, tot, 1.0), p_cut)
+    sampled = jax.vmap(jax.random.categorical)(res_keys, jnp.log(res))
+    corr = jnp.where(greedy, jnp.argmax(p_cut, axis=-1),
+                     sampled).astype(jnp.int32)
+    out = jnp.concatenate(
+        [draft_tokens, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    out = jnp.where(jnp.arange(k + 1)[None, :] == n_accept[:, None],
+                    corr[:, None], out)
+    return out, n_accept
 
 
 def reset_cache_positions(cache, new_index):
@@ -149,11 +240,15 @@ def reset_cache_positions(cache, new_index):
     the bucketing trick: after a PADDED prefill advanced the counters to
     the bucket length, rewind them to the true prompt length so decode
     overwrites the pad rows (which the position mask keeps unattendable
-    until then)."""
+    until then). ``new_index`` may be a scalar or, for a slot-decode
+    (``decode_slots > 0``) cache, a per-row [slots] vector — the
+    speculative decoder rewinds each row to its OWN accepted length this
+    way (scanned-layer counter leaves are [L, slots]; the vector
+    broadcasts up the scan axis)."""
     def fix(path, leaf):
         name = getattr(path[-1], "key", str(path[-1]))
         if name in ("index", "pos_index"):
-            return jnp.full_like(leaf, new_index)
+            return jnp.broadcast_to(new_index, leaf.shape).astype(leaf.dtype)
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
@@ -428,3 +523,258 @@ def generate_bucketed(
                            eos_ids=stop_ids_tuple(eos_id), rng=rng)
     return jnp.concatenate(
         [prompt, out[:, padded_len:padded_len + max_new_tokens]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (ISSUE 8): draft-and-verify with lossless rejection
+# sampling. Decode is memory-bound — every tick streams the whole target
+# model through HBM for ONE token — so a cheap draft proposes k tokens and
+# the target scores all k+1 positions in ONE batched forward; the rejection
+# kernel (speculative_accept) keeps a provably target-distributed prefix.
+# Greedy outputs are BITWISE-equal to generate()'s (the kernel degenerates
+# to accept-iff-argmax-matches); sampled outputs are distribution-equal.
+
+
+def truncated_draft(model, params, num_layers: int):
+    """(draft_model, draft_params) built by TRUNCATING the target to its
+    first ``num_layers`` transformer blocks — embedder, final norm and LM
+    head shared, so vocab/embedding shapes match by construction. A free
+    draft for speculative decoding: no extra training, and correctness
+    never depends on its quality (the rejection kernel is lossless); only
+    the acceptance rate — and hence the speedup — does."""
+    cfg = model.cfg
+    if not 0 < num_layers < cfg.num_layers:
+        raise ValueError(
+            f"draft num_layers {num_layers} must be in "
+            f"[1, {cfg.num_layers - 1}] (a strict truncation of the target)")
+    p = params["params"] if "params" in params else params
+    h = dict(p["h"])
+    if cfg.scan_layers:
+        # scan-stacked block leaves are [L, ...]: slice the layer axis
+        h["block"] = jax.tree.map(lambda a: a[:num_layers], h["block"])
+    else:
+        for name in list(h):
+            if (name.startswith("block_")
+                    and int(name.rsplit("_", 1)[1]) >= num_layers):
+                del h[name]
+    out = dict(p)
+    out["h"] = h
+    draft = model.clone(cfg=dataclasses.replace(cfg, num_layers=num_layers))
+    return draft, {"params": out}
+
+
+def draft_and_verify(model, draft_model, weights, draft_weights, cache,
+                     draft_cache, tok, draft_keys, unif, res_keys,
+                     temperature, top_k, top_p, *, spec_k: int,
+                     candidates: int):
+    """One draft-and-verify round over per-row decode state — the
+    losslessness-critical core shared by generate_speculative and the
+    serving engine's spec_decode_tick (they differ only in how caches
+    persist and keys derive; this math must never fork).
+
+    Rolls the draft ``spec_k + 1`` single-token steps from ``tok`` (k
+    proposals, plus one extra step that only writes the last proposal's
+    K/V so a fully-accepted row's next round attends a complete draft
+    cache), scores all k+1 positions with ONE target forward over
+    [tok, d_1..d_k], and rejection-samples per row. ``draft_keys`` is a
+    [spec_k+1, n] key array (one stream per rollout step per row);
+    ``unif`` [n, spec_k] are the accept coins, ``res_keys`` [n] the
+    residual/bonus streams. Returns ``(cache, draft_cache, emitted
+    [n, spec_k+1], n_accept [n])`` — the caller consumes exactly
+    n_accept+1 tokens per row."""
+    n = tok.shape[0]
+
+    def dstep(carry, keys_j):
+        dc, t = carry
+        logits, mut = draft_model.apply(
+            {"params": draft_weights, "cache": dc}, t[:, None],
+            mutable=["cache"])
+        lg = logits[:, 0].astype(jnp.float32)
+        nxt = sample_slots(lg, keys_j, temperature, top_k, top_p,
+                           candidates=candidates)
+        q = slot_filtered_probs(lg, temperature, top_k, top_p,
+                                candidates=candidates)
+        return (mut["cache"], nxt), (nxt, q)
+
+    (draft_cache, _), (dtoks, qs) = lax.scan(
+        dstep, (draft_cache, tok), draft_keys)
+    d_prop = dtoks[:spec_k].T                        # [n, k]
+    q_probs = jnp.moveaxis(qs[:spec_k], 0, 1)        # [n, k, vocab]
+    chunk = jnp.concatenate([tok[:, None], d_prop], axis=1)
+    logits, mut = model.apply(
+        {"params": weights, "cache": cache}, chunk, mutable=["cache"])
+    flat = logits.reshape(n * (spec_k + 1), -1).astype(jnp.float32)
+
+    def rep(a):
+        return jnp.repeat(a, spec_k + 1, axis=0)
+
+    p_probs = slot_filtered_probs(
+        flat, rep(temperature), rep(top_k), rep(top_p),
+        candidates=candidates).reshape(n, spec_k + 1, -1)
+    emitted, n_accept = speculative_accept(
+        d_prop, q_probs, p_probs, unif, res_keys, temperature <= 0.0)
+    return mut["cache"], draft_cache, emitted, n_accept
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "draft_model", "spec_k", "max_new_tokens",
+                     "temperature", "top_k", "top_p", "eos_ids",
+                     "candidates"))
+def _speculative_jit(model, draft_model, params, draft_params, prompt, rng,
+                     *, spec_k: int, max_new_tokens: int, temperature: float,
+                     top_k: int | None, top_p: float | None,
+                     eos_ids: tuple[int, ...], candidates: int):
+    """The jitted body behind generate_speculative: chunked prefill of
+    BOTH caches, then a lax.while_loop of draft-and-verify rounds. Both
+    models are slot-decode clones (``decode_slots == batch``) because
+    per-row accepted lengths diverge — every round re-stamps the position
+    counters from the per-row length vector (reset_cache_positions), so
+    rejected-suffix K/V needs no rollback: the next round's k+1 writes
+    land at [len, len+k] and always cover the stale region, and the
+    position mask keeps anything beyond a row's length unattendable."""
+    TRACE_COUNTS["generate_speculative"] += 1
+    b, plen = prompt.shape
+    weights = params["params"] if "params" in params else params
+    dweights = (draft_params["params"] if "params" in draft_params
+                else draft_params)
+    temps = jnp.full((b,), temperature, jnp.float32)
+    tks = jnp.full((b,), top_k or 0, jnp.int32)
+    tps = jnp.full((b,), 1.0 if top_p is None else top_p, jnp.float32)
+
+    t_cache = _zero_cache(model, prompt)
+    d_cache = _zero_cache(draft_model, prompt)
+    logits, mut = model.apply(
+        {"params": weights, "cache": t_cache}, prompt, mutable=["cache"])
+    t_cache = mut["cache"]
+    _, dmut = draft_model.apply(
+        {"params": dweights, "cache": d_cache}, prompt, mutable=["cache"])
+    d_cache = dmut["cache"]
+
+    rng, sub = jax.random.split(rng)
+    first = sample_slots(logits[:, -1].astype(jnp.float32),
+                         jax.random.split(sub, b), temps, tks, tps,
+                         candidates=candidates)
+    width = max_new_tokens + spec_k + 1
+    out = jnp.zeros((b, width), jnp.int32).at[:, 0].set(first)
+    n_out = jnp.ones((b,), jnp.int32)
+    done = matches_stop(first, eos_ids) | (n_out >= max_new_tokens)
+    pos = jnp.full((b,), plen, jnp.int32)
+
+    def cond(carry):
+        return jnp.any(~carry[5])
+
+    def body(carry):
+        t_cache, d_cache, out, n_out, tok, done, pos, key = carry
+        t_cache = reset_cache_positions(t_cache, pos)
+        d_cache = reset_cache_positions(d_cache, pos)
+        key, kd, ka, kr = jax.random.split(key, 4)
+        draft_keys = jax.vmap(lambda kj: jax.random.split(kj, b))(
+            jax.random.split(kd, spec_k + 1))
+        unif = jax.random.uniform(ka, (b, spec_k))
+        t_cache, d_cache, emitted, n_acc = draft_and_verify(
+            model, draft_model, weights, dweights, t_cache, d_cache, tok,
+            draft_keys, unif, jax.random.split(kr, b), temps, tks, tps,
+            spec_k=spec_k, candidates=candidates)
+        if eos_ids:
+            # a stop id freezes the rest of the round: everything after
+            # it emits the first stop id, exactly generate()'s frozen-row
+            # padding
+            hit = matches_stop(emitted, eos_ids)
+            prior = jnp.cumsum(hit, axis=1) - hit > 0
+            emitted = jnp.where(prior, eos_ids[0], emitted)
+
+        def wrow(buf, vals, start, skip):
+            return jnp.where(
+                skip, buf, lax.dynamic_update_slice(buf, vals, (start,)))
+
+        out = jax.vmap(wrow)(out, emitted, n_out, done)
+        m_emit = n_acc + 1
+        tok = jnp.where(done, tok, emitted[jnp.arange(b), n_acc])
+        n_out = jnp.where(done, n_out, n_out + m_emit)
+        new_done = done | (n_out >= max_new_tokens)
+        if eos_ids:
+            live = jnp.arange(spec_k + 1)[None, :] <= n_acc[:, None]
+            new_done = new_done | (
+                ~done & (matches_stop(emitted, eos_ids) & live).any(axis=1))
+        # freeze pos at the pre-round value for rows that just finished:
+        # live rows keep pos == plen + n_out - 1 <= plen + max_new - 2,
+        # so verify writes never pass plen + max_new + spec_k - 2 (the
+        # wrapper's validation slack)
+        pos = jnp.where(new_done, pos, pos + m_emit)
+        return (t_cache, d_cache, out, n_out, tok, new_done, pos, key)
+
+    carry = (t_cache, d_cache, out, n_out, first, done, pos, rng)
+    _, _, out, n_out, _, _, _, _ = lax.while_loop(cond, body, carry)
+    pad = eos_ids[0] if eos_ids else 0
+    res = jnp.where(jnp.arange(width)[None, :] < n_out[:, None], out, pad)
+    return jnp.concatenate([prompt, res[:, :max_new_tokens]], axis=1)
+
+
+def generate_speculative(
+    model,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    draft_model=None,
+    draft_params=None,
+    spec_k: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_id=None,
+    rng=None,
+    candidates: int = 64,
+):
+    """generate() with draft-and-verify speculative decoding: ``spec_k``
+    draft proposals per target forward, losslessly verified (Leviathan
+    et al. 2023). Greedy output is BITWISE-equal to generate()'s; sampled
+    output is distribution-equal (the tokens follow exactly the filtered
+    target distribution sample_slots draws from, whatever the draft).
+
+    Args beyond generate()'s:
+      draft_model / draft_params: the proposer — any causal LM sharing
+        the target's vocab (e.g. `truncated_draft(model, params, n)`).
+        None self-drafts with the target itself (acceptance ~1: the
+        correctness/plumbing configuration, not a speedup).
+      spec_k: static draft length per round (0 falls back to generate()).
+      candidates: the sampler's candidate-set width (see sample_slots) —
+        spec and plain sampling share the same filtered distribution.
+
+    Falls back to plain generate() when the context cannot absorb the
+    verify overshoot (prompt + max_new + spec_k must fit max_seq_len:
+    each round's k+1 verify writes may run past the budget before the
+    accepted length is known — rejected-suffix K/V is never rolled back,
+    just overwritten by the next round)."""
+    _validate(model, prompt.shape[1], max_new_tokens)
+    b, plen = prompt.shape
+    kw = dict(max_new_tokens=max_new_tokens, temperature=temperature,
+              top_k=top_k, top_p=top_p, eos_id=eos_id, rng=rng)
+    if spec_k < 1 or plen + max_new_tokens + spec_k > model.cfg.max_seq_len:
+        return generate(model, params, prompt, **kw)
+    if draft_model is None:
+        draft_model, draft_params = model, params
+    if draft_params is None:
+        raise ValueError("draft_model without draft_params — pass both "
+                         "(truncated_draft() builds the pair)")
+    if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.cfg.vocab_size} != target vocab "
+            f"{model.cfg.vocab_size} (the draft proposes target tokens)")
+
+    def slot_clone(m, seq_len):
+        return m.clone(cfg=dataclasses.replace(
+            m.cfg, decode=True, attention="dense", decode_attend_len=None,
+            decode_slots=b, kv_block_size=0, kv_blocks=0,
+            max_seq_len=seq_len))
+
+    if rng is None:
+        rng = jax.random.key(0)
+    return _speculative_jit(
+        slot_clone(model, model.cfg.max_seq_len),
+        slot_clone(draft_model, model.cfg.max_seq_len),
+        params, draft_params, prompt, rng, spec_k=spec_k,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, eos_ids=stop_ids_tuple(eos_id),
+        candidates=candidates)

@@ -136,7 +136,13 @@ class TransformerConfig:
     # row ("slot") sits at its OWN sequence position — requests of
     # different lengths decode in one compiled step. Requires decode=True
     # and batch == decode_slots; 0 keeps the scalar counters generate()
-    # uses (all rows advance together).
+    # uses (all rows advance together). Chunks of ANY length s decode
+    # per-row (positions idx[row] + [0, s): within-chunk causality from
+    # the position mask, writes land at [idx, idx+s)) and are
+    # BITWISE-equal to s sequential single-token ticks — the multi-token
+    # verify contract speculative decoding (ISSUE 8) builds on: a k+1
+    # chunk whose suffix is later rejected needs no rollback, because the
+    # next chunk's writes start at the accepted length and cover it.
     decode_slots: int = 0
     # Paged KV cache (serving/ — ISSUE 7, vLLM's PagedAttention realized
     # TPU-natively): kv_block_size > 0 replaces each attention layer's

@@ -6,7 +6,10 @@
                    per-request token streaming. ``block_size > 0``
                    switches to the PAGED engine (ISSUE 7): block-table
                    KV pool, radix prefix reuse, chunked prefill,
-                   preempt-requeue
+                   preempt-requeue. ``spec_k > 0`` adds SPECULATIVE
+                   decoding (ISSUE 8): a draft model proposes k tokens
+                   per slot, verified losslessly in one target forward
+                   per tick (spec_decode_tick)
   * paging.py    — BlockAllocator (refcounted pool free-list, trash
                    block, leak invariant) + RadixPrefixCache
                    (block-granularity prefix trie, LRU eviction)
@@ -30,6 +33,7 @@ from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
     paged_slot_models,
     prefill_into_slot,
     slot_models,
+    spec_decode_tick,
 )
 from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
     BlockAllocator,

@@ -40,12 +40,23 @@ slots x max_seq_len. Tables/lengths are host numpy stamped into each
 call as dynamic arguments, so all of it is host bookkeeping between
 two fixed compiled programs (paged_decode_tick / paged_prefill_chunk).
 
+Speculative mode (ISSUE 8, ``spec_k > 0``, paged only) replaces the
+one-token tick with **draft-and-verify**: a draft model proposes
+``spec_k`` tokens per slot inside one fused compiled program
+(`spec_decode_tick` — draft rollout scan + ONE k+1-wide target forward
+through the same paged scatter/gather + the lossless rejection kernel,
+both pools donated), and each slot advances by its accepted length + 1.
+Decode is memory-bound, so accepted tokens per target forward is the
+decode-rate multiplier; losslessness means draft quality can only cost
+acceptance rate, never correctness.
+
 Composition: params may be dp/tp sharded (pass the mesh) and quantized
 (`--quant` int8 policies) exactly as generate() accepts them — the tick
 and prefill run the same decode einsums under the same logical rules.
 Greedy outputs are bitwise-equal to generate()'s per request, for any
-admission order — prefix hits, chunk boundaries and preemptions
-included (tests/test_serving.py + tests/test_paging.py pin it).
+admission order — prefix hits, chunk boundaries, preemptions and
+speculation included (tests/test_serving.py + tests/test_paging.py +
+tests/test_spec.py pin it).
 """
 
 from __future__ import annotations
@@ -63,6 +74,7 @@ import numpy as np
 
 from pytorchdistributed_tpu.inference import (
     _zero_cache,
+    draft_and_verify,
     kv_cache_bytes,
     sample_slots,
     stop_ids_tuple,
@@ -286,6 +298,64 @@ def paged_prefill_chunk(model, weights, cache, chunk, start, table_row,
     return new_cache, first
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "draft_model", "spec_k", "candidates"),
+    donate_argnames=("cache", "draft_cache"))
+def spec_decode_tick(model, draft_model, weights, draft_weights, cache,
+                     draft_cache, tables, lengths, tokens, key_data, counts,
+                     temperature, top_k, top_p, *, spec_k: int,
+                     candidates: int):
+    """The speculative twin of paged_decode_tick (ISSUE 8): ONE compiled
+    program per tick that (a) rolls the draft model ``spec_k + 1``
+    single-token steps from each slot's last token (k proposals, plus one
+    extra step that only writes the last proposal's K/V so a
+    fully-accepted slot's next round attends a complete draft cache),
+    (b) scores all k+1 positions with ONE target forward — the verify
+    chunk [last_tok, d_1..d_k] rides the same paged scatter/gather path,
+    so draft K/V lands in table-mapped blocks and anything past
+    max_seq_len drops into trash block 0 — and (c) runs the lossless
+    rejection kernel (inference.speculative_accept) per slot.
+
+    Both caches share the SAME host-stamped block tables: the draft pool
+    is a second (shallower) set of block arrays addressed by identical
+    block ids, so growth/preemption/trash bookkeeping is one table. No
+    rollback pass exists anywhere: the host advances each slot's length
+    by its accepted count + 1, and the NEXT round's k+1 writes at
+    [len, len+k] always cover this round's rejected-suffix K/V before
+    anything can attend it (the position mask bounds reads at len).
+
+    Returns ``(cache, draft_cache, tokens [slots, k+1], n_accept
+    [slots])`` — the host delivers exactly n_accept+1 tokens per slot.
+    Randomness: a round at generated-count c derives every stream from
+    fold_in(request_key, c) (draft step j → fold_in twice with tag 1 and
+    j; accept uniforms tag 2; residual tag 3), so sampled outputs are a
+    function of (prompt, sampling params, seed, scheduling) alone — the
+    same request in any admission order reproduces its tokens. One
+    honest caveat vs the plain tick: a preempt-RESUME re-derives the
+    resumed token from the prefill sampler rather than the interrupted
+    round's streams, so a SAMPLED stream's post-resume suffix is a
+    different (equally target-distributed) sample than the
+    uninterrupted run's; greedy streams are bitwise-stable across
+    preemption either way (tests/test_spec.py pins that)."""
+    TRACE_COUNTS["spec_decode_tick"] += 1
+    cache = _override_paging(cache, tables, lengths)
+    draft_cache = _override_paging(draft_cache, tables, lengths)
+    keys = jax.random.wrap_key_data(key_data)
+    base = jax.vmap(jax.random.fold_in)(keys, counts)
+    step1 = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 1)
+    draft_keys = jax.vmap(
+        lambda j: jax.vmap(jax.random.fold_in, in_axes=(0, None))(step1, j)
+    )(jnp.arange(spec_k + 1))
+    acc_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 2)
+    unif = jax.vmap(lambda k_: jax.random.uniform(k_, (spec_k,)))(acc_keys)
+    res_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 3)
+    return draft_and_verify(
+        model, draft_model, weights, draft_weights, cache, draft_cache,
+        tokens, draft_keys, unif, res_keys, temperature, top_k, top_p,
+        spec_k=spec_k, candidates=candidates)
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs (dynamic per slot — any mix of requests
@@ -332,6 +402,11 @@ class Request:
         self.prefix_hit_tokens = 0
         self.prefill_chunks = 0
         self.preemptions = 0
+        # speculative-decoding lifecycle (zero when spec is off): draft
+        # proposals made for this request and how many the target kept —
+        # accepted/draft is the request's acceptance rate
+        self.draft_tokens = 0
+        self.accepted_tokens = 0
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -400,6 +475,22 @@ class ServingEngine:
         paged mode.
       prefill_chunks_per_step: chunk calls per step() once slots are
         decoding (1 = maximally latency-protective interleaving).
+      spec_k: > 0 turns on SPECULATIVE decoding (ISSUE 8): every tick a
+        draft model proposes spec_k tokens per slot and the target
+        verifies all of them in ONE forward (spec_decode_tick) with
+        lossless rejection sampling — greedy outputs stay bitwise-equal
+        to generate()'s, sampled outputs distribution-equal, whatever
+        the draft quality; only the acceptance rate (and the speedup)
+        depends on it. Requires the paged engine (block_size > 0):
+        rejected-suffix and past-context K/V drop into the trash block
+        instead of needing a rollback. 0 = the plain tick (default, no
+        behavior change).
+      draft_config: the draft's TransformerConfig (same vocab; usually a
+        reduced-depth clone of the target — inference.truncated_draft
+        builds config+params from the target in one call). None
+        self-drafts with the target model itself: acceptance ~1, the
+        correctness/bring-up configuration.
+      draft_params: the draft's variables (required with draft_config).
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
@@ -409,7 +500,8 @@ class ServingEngine:
                  num_blocks: int | None = None,
                  prefill_chunk: int | None = None,
                  prefix_cache: bool = True,
-                 prefill_chunks_per_step: int = 1):
+                 prefill_chunks_per_step: int = 1,
+                 spec_k: int = 0, draft_config=None, draft_params=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -466,13 +558,51 @@ class ServingEngine:
             self._admit_order = np.zeros(num_slots, np.int64)
             self._admit_seq = itertools.count(1)
             self._prefilling: dict | None = None
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.spec_k = spec_k
+        if spec_k:
+            if not self.paged:
+                raise ValueError(
+                    "spec_k > 0 requires the paged engine (block_size > "
+                    "0): the verify forward's rejected-suffix K/V writes "
+                    "must drop into the trash block, not clamp onto live "
+                    "dense rows")
+            if draft_config is not None and draft_params is None:
+                raise ValueError(
+                    "draft_config without draft_params — pass both "
+                    "(inference.truncated_draft builds the pair), or "
+                    "neither to self-draft with the target")
+            if draft_config is None:
+                draft_config, draft_params = model.cfg, params
+            if draft_config.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {model.cfg.vocab_size}")
+            # the draft shares the target's block TABLES (same block ids
+            # into its own shallower pool), so its geometry must match
+            draft_base = model.clone(cfg=dataclasses.replace(
+                draft_config, max_seq_len=model.cfg.max_seq_len))
+            self._draft_tick_model, self._draft_chunk_model = \
+                paged_slot_models(draft_base, num_slots, self.block_size,
+                                  self.num_blocks)
+            self._draft_weights = (draft_params["params"]
+                                   if "params" in draft_params
+                                   else draft_params)
         self._weights = params["params"] if "params" in params else params
         with self._mesh_ctx():
             self._cache = _zero_cache(
                 self._tick_model, jnp.zeros((num_slots, 1), jnp.int32))
+            if spec_k:
+                self._draft_cache = _zero_cache(
+                    self._draft_tick_model,
+                    jnp.zeros((num_slots, 1), jnp.int32))
         # the KV cache HBM footprint (pool or dense rows) — the bench's
-        # capacity-per-byte denominator
+        # capacity-per-byte denominator; the draft pool is accounted
+        # separately (it shares block IDs, not bytes)
         self.kv_hbm_bytes = kv_cache_bytes(self._cache)
+        self.draft_kv_hbm_bytes = (
+            kv_cache_bytes(self._draft_cache) if spec_k else 0)
         kd = np.asarray(jax.random.key_data(jax.random.key(0)))
         self._key_data = np.zeros((num_slots,) + kd.shape, kd.dtype)
         self._tokens = np.zeros(num_slots, np.int32)
@@ -550,7 +680,9 @@ class ServingEngine:
         decoded = 0
         if self.paged and self._active:
             self._grow_slots()  # back this tick's write positions
-        if self._active:
+        if self._active and self.spec_k:
+            decoded = self._spec_step()
+        elif self._active:
             t0 = time.perf_counter()
             with self._span("serve/decode_tick"), self._mesh_ctx():
                 # one shared per-slot argument tail; the paged tick just
@@ -596,6 +728,66 @@ class ServingEngine:
         return {"admitted": admitted, "decoded": decoded,
                 "expired": expired, "active": len(self._active),
                 "queued": len(self._queue)}
+
+    def _spec_step(self) -> int:
+        """One speculative decode tick over all slots (spec_decode_tick)
+        and its host bookkeeping: each active slot advances by its own
+        accepted length + 1, delivery stops early at a stop id or the
+        token budget (the undelivered remainder of a round is simply
+        discarded — it was never part of the request's stream), and the
+        per-slot length/count vectors move by exactly the delivered-or-
+        accepted span so the next tick's verify writes cover this round's
+        rejected suffix. Returns the number of delivered tokens."""
+        st = self._stats
+        t0 = time.perf_counter()
+        with self._span("serve/spec_tick"), self._mesh_ctx():
+            (self._cache, self._draft_cache, out, nacc) = spec_decode_tick(
+                self._tick_model, self._draft_tick_model, self._weights,
+                self._draft_weights, self._cache, self._draft_cache,
+                jnp.asarray(self._tables), jnp.asarray(self._lengths),
+                jnp.asarray(self._tokens), jnp.asarray(self._key_data),
+                jnp.asarray(self._counts),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps),
+                spec_k=self.spec_k, candidates=self.candidates)
+            toks = np.asarray(out)   # host sync: streaming delivery
+            ns = np.asarray(nacc)
+        dt = time.perf_counter() - t0
+        n_active = len(self._active)
+        st["ticks"] += 1
+        st["tick_s"] += dt
+        st["occupancy_sum"] += n_active / self.num_slots
+        used = self._alloc.usable - self._alloc.free_count
+        st["block_used_sum"] += used / self._alloc.usable
+        decoded = accepted = 0
+        for slot, req in list(self._active.items()):
+            n = int(ns[slot])
+            # the round's writes + randomness are consumed whether or not
+            # every token gets delivered; a retiring request's slot state
+            # is reset by _release_slot anyway
+            self._lengths[slot] += n + 1
+            self._counts[slot] += n + 1
+            st["draft_tokens"] += self.spec_k
+            st["accepted_tokens"] += n
+            st["target_forwards"] += 1
+            req.draft_tokens += self.spec_k
+            req.accepted_tokens += n
+            accepted += n
+            for j in range(n + 1):
+                self._deliver(req, int(toks[slot, j]))
+                decoded += 1
+                if req.done:
+                    break
+        st["decode_tokens"] += decoded
+        if self.telemetry is not None:
+            self.telemetry.tick(
+                tick=st["ticks"], tick_ms=round(dt * 1e3, 3),
+                active=len(self._active), queued=len(self._queue),
+                slot_occupancy=round(n_active / self.num_slots, 4),
+                blocks_used=used, blocks_free=self._alloc.free_count,
+                spec_k=self.spec_k, accepted_tokens=accepted,
+                decoded_tokens=decoded)
+        return decoded
 
     # ------------------------------------------------------------------
     # paged admission: chunked prefill + prefix reuse + block accounting
@@ -699,43 +891,73 @@ class ServingEngine:
         self._prefilling = dict(
             req=req, slot=slot, tokens=tokens, true_len=true_len, pos=m,
             resume=len(req.new_tokens), table_row=table_row,
+            # spec: the draft prefill also starts at the prefix-hit
+            # offset — radix-held blocks keep their draft K/V resident
+            # (same block ids into the draft pool, written by the
+            # admission that cached them), and every position below a
+            # slot's length is rewritten with ACCEPTED tokens before the
+            # length passes it (the covering-writes property), so cached
+            # draft K/V is always conditioned on the true prefix
+            dpos=m, first=None,
             kd=np.asarray(jax.random.key_data(
                 jax.random.key(req.sampling.seed))))
         return True
 
-    def _prefill_chunk_step(self) -> int:
-        """Run ONE chunk of the in-flight admission; on the final chunk,
-        sample the request's next token and activate the slot. Returns 1
-        on completed admission, else 0."""
-        pf = self._prefilling
-        req, slot, pos = pf["req"], pf["slot"], pf["pos"]
+    def _chunk_call(self, model, weights, cache, pf, pos):
+        """One paged_prefill_chunk call for the admission in flight, at
+        absolute position ``pos`` of its token stream — shared by the
+        target and (spec mode) draft cache fills."""
+        req = pf["req"]
         chunk = np.zeros((1, self.chunk), np.int32)
         n = min(self.chunk, pf["true_len"] - pos)
         chunk[0, :n] = pf["tokens"][pos:pos + n]
-        final = pos + self.chunk >= pf["true_len"]
+        return paged_prefill_chunk(
+            model, weights, cache,
+            jnp.asarray(chunk), jnp.int32(pos),
+            jnp.asarray(pf["table_row"]),
+            jnp.int32(pf["true_len"]),
+            jnp.asarray(pf["kd"]),
+            jnp.int32(pf["resume"]),
+            jnp.float32(req.sampling.temperature),
+            jnp.int32(req.sampling.top_k),
+            jnp.float32(req.sampling.top_p),
+            candidates=self.candidates)
+
+    def _prefill_chunk_step(self) -> int:
+        """Run ONE chunk step of the in-flight admission — a target
+        chunk while the target cache is short of the prompt, plus (spec
+        mode) a draft chunk filling the draft pool over the SAME blocks
+        (both start at the prefix-hit offset: matched blocks carry valid
+        draft K/V from the admission that cached them) — and, once both
+        caches cover the prompt, activate the slot with the target's
+        sampled next token. Returns 1 on completed admission, else 0."""
+        pf = self._prefilling
+        req, slot = pf["req"], pf["slot"]
         t0 = time.perf_counter()
         with self._span("serve/prefill"), self._mesh_ctx():
-            self._cache, first = paged_prefill_chunk(
-                self._chunk_model, self._weights, self._cache,
-                jnp.asarray(chunk), jnp.int32(pos),
-                jnp.asarray(pf["table_row"]),
-                jnp.int32(pf["true_len"]),
-                jnp.asarray(pf["kd"]),
-                jnp.int32(pf["resume"]),
-                jnp.float32(req.sampling.temperature),
-                jnp.int32(req.sampling.top_k),
-                jnp.float32(req.sampling.top_p),
-                candidates=self.candidates)
-            if final:
-                first = int(first)  # sync: the TTFT timestamp is honest
+            if pf["pos"] < pf["true_len"]:
+                pos = pf["pos"]
+                final_t = pos + self.chunk >= pf["true_len"]
+                self._cache, first = self._chunk_call(
+                    self._chunk_model, self._weights, self._cache, pf, pos)
+                if final_t:
+                    # sync: the TTFT timestamp is honest
+                    pf["first"] = int(first)
+                pf["pos"] = pos + self.chunk
+            if self.spec_k and pf["dpos"] < pf["true_len"]:
+                self._draft_cache, _ = self._chunk_call(
+                    self._draft_chunk_model, self._draft_weights,
+                    self._draft_cache, pf, pf["dpos"])
+                pf["dpos"] += self.chunk
         now = time.perf_counter()
         st = self._stats
         st["prefill_s"] += now - t0
         st["prefill_chunks"] += 1
         req.prefill_chunks += 1
-        pf["pos"] = pos + self.chunk
-        if not final:
+        if pf["pos"] < pf["true_len"] or (
+                self.spec_k and pf["dpos"] < pf["true_len"]):
             return 0
+        first = pf["first"]
         # admission complete: cache the prompt's full blocks for future
         # arrivals, publish the real table to the tick's view, rewind to
         # the true length, activate the slot
@@ -764,17 +986,21 @@ class ServingEngine:
 
     def _grow_slots(self) -> None:
         """Back every active slot's next write position with a physical
-        block, oldest admissions first. When the pool is exhausted even
-        after prefix-cache eviction, preempt the YOUNGEST resident
-        request (free its blocks, requeue it at the front — it resumes
-        later by re-prefilling prompt + generated, output unchanged)
-        until the older stream can proceed."""
+        block, oldest admissions first — a speculative tick writes
+        [len, len+spec_k], so spec serving backs the whole span (clamped
+        to the context: past-max_seq_len writes go to the trash block and
+        need no backing). When the pool is exhausted even after
+        prefix-cache eviction, preempt the YOUNGEST resident request
+        (free its blocks, requeue it at the front — it resumes later by
+        re-prefilling prompt + generated, output unchanged) until the
+        older stream can proceed."""
         for slot in sorted(self._active,
                            key=lambda s: self._admit_order[s]):
             if slot not in self._active:
                 continue  # preempted by an older slot's growth
             blocks = self._slot_blocks[slot]
-            bi = int(self._lengths[slot]) // self.block_size
+            bi = min(int(self._lengths[slot]) + self.spec_k,
+                     self.cfg.max_seq_len - 1) // self.block_size
             while bi >= len(blocks):
                 fresh = self._alloc_blocks(1)
                 if fresh is not None:
@@ -925,6 +1151,14 @@ class ServingEngine:
         if self.paged:
             if self.telemetry is not None:
                 st = self._stats
+                spec = (dict(spec_k=self.spec_k,
+                             draft_tokens=st["draft_tokens"],
+                             accepted_tokens=st["accepted_tokens"],
+                             acceptance_rate=(
+                                 round(st["accepted_tokens"]
+                                       / st["draft_tokens"], 4)
+                                 if st["draft_tokens"] else None))
+                        if self.spec_k else {})
                 self.telemetry.pool(
                     kv_hbm_bytes=self.kv_hbm_bytes,
                     block_size=self.block_size,
@@ -933,6 +1167,7 @@ class ServingEngine:
                     preemptions=st["preemptions"],
                     prefix_hit_tokens=st["prefix_hit_tokens"],
                     admitted_tokens=st["admitted_tokens"],
+                    **spec,
                     **(self._radix.stats() if self._radix is not None
                        else {}))
             cached = (self._radix.block_count
@@ -1031,7 +1266,10 @@ class ServingEngine:
                            # paged-mode counters (stay 0 on dense)
                            admissions=0, admitted_tokens=0,
                            prefix_hit_tokens=0, prefill_chunks=0,
-                           preemptions=0, block_used_sum=0.0)
+                           preemptions=0, block_used_sum=0.0,
+                           # speculative counters (stay 0 when spec off)
+                           draft_tokens=0, accepted_tokens=0,
+                           target_forwards=0)
 
     @property
     def queue_depth(self) -> int:
@@ -1090,4 +1328,18 @@ class ServingEngine:
             out["prefix_hit_tokens"] = st["prefix_hit_tokens"]
             if self._radix is not None:
                 out["prefix_cache"] = self._radix.stats()
+        if self.spec_k:
+            out["spec_k"] = self.spec_k
+            out["draft_tokens"] = st["draft_tokens"]
+            out["accepted_tokens"] = st["accepted_tokens"]
+            out["acceptance_rate"] = (
+                round(st["accepted_tokens"] / st["draft_tokens"], 4)
+                if st["draft_tokens"] else None)
+            # emitted tokens per target-model forward — the speculative
+            # multiplier on the memory-bound decode path (1.0 when spec
+            # is off; up to spec_k + 1 at full acceptance)
+            out["tokens_per_target_forward"] = (
+                round(st["decode_tokens"] / st["target_forwards"], 3)
+                if st["target_forwards"] else None)
+            out["draft_kv_hbm_bytes"] = self.draft_kv_hbm_bytes
         return out

@@ -59,7 +59,8 @@ class ServingTelemetry:
         """One completed-request row: TTFT + per-request decode rate,
         plus the paged-engine lifecycle (prefix-cache tokens admitted by
         reference, prefill chunks paid, preempt round-trips — all 0 on
-        the dense engine)."""
+        the dense engine) and the speculative counters (draft proposals
+        made / accepted — both 0 when spec is off)."""
         ttft = req.ttft_s
         self.metrics.write({
             "kind": "request", "time": round(time.time(), 3),
@@ -71,6 +72,8 @@ class ServingTelemetry:
             "prefix_hit_tokens": getattr(req, "prefix_hit_tokens", 0),
             "prefill_chunks": getattr(req, "prefill_chunks", 0),
             "preemptions": getattr(req, "preemptions", 0),
+            "draft_tokens": getattr(req, "draft_tokens", 0),
+            "accepted_tokens": getattr(req, "accepted_tokens", 0),
         })
 
     def pool(self, **row) -> None:

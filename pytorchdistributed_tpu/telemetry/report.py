@@ -200,10 +200,12 @@ def _serving_section(run_dir: str) -> list[str]:
                                    "serve_metrics_rank")
     if not rows_by_rank:
         return []
-    lines = ["serving (per rank: requests / TTFT / prefix cache):"]
+    lines = ["serving (per rank: requests / TTFT / prefix cache / "
+             "speculation):"]
     lines.append(f"  {'rank':>4}  {'reqs':>5}  {'ttft p50':>9}  "
                  f"{'hit tok':>8}  {'hit rate':>8}  {'chunks':>6}  "
-                 f"{'preempt':>7}  {'cached blk':>10}  {'kv hbm':>9}")
+                 f"{'preempt':>7}  {'acc rate':>8}  {'cached blk':>10}  "
+                 f"{'kv hbm':>9}")
     for rank, rows in sorted(rows_by_rank.items()):
         reqs = [r for r in rows if r.get("kind") == "request"]
         pool = next((r for r in reversed(rows)
@@ -221,11 +223,16 @@ def _serving_section(run_dir: str) -> list[str]:
         rate = f"{hit_tok / denom:.2%}" if denom else "-"
         chunks = sum(r.get("prefill_chunks") or 0 for r in reqs)
         preempt = sum(r.get("preemptions") or 0 for r in reqs)
+        # speculative-decoding health (ISSUE 8): accepted / proposed
+        # draft tokens across the rank's requests — "-" when spec is off
+        drafted = sum(r.get("draft_tokens") or 0 for r in reqs)
+        accepted = sum(r.get("accepted_tokens") or 0 for r in reqs)
+        acc = f"{accepted / drafted:.2%}" if drafted else "-"
         cached = pool.get("cached_blocks", "-") if pool else "-"
         hbm = _fmt_bytes(pool.get("kv_hbm_bytes")) if pool else "-"
         lines.append(f"  {rank:>4}  {len(reqs):>5}  {p50:>9}  "
                      f"{hit_tok:>8}  {rate:>8}  {chunks:>6}  "
-                     f"{preempt:>7}  {cached!s:>10}  {hbm:>9}")
+                     f"{preempt:>7}  {acc:>8}  {cached!s:>10}  {hbm:>9}")
     pools = [r for rows in rows_by_rank.values() for r in rows
              if r.get("kind") == "pool"]
     if pools:
@@ -240,6 +247,12 @@ def _serving_section(run_dir: str) -> list[str]:
             f"{p.get('block_size', '-')}-token blocks, "
             f"cache {hits}/{lookups} lookups hit, "
             f"{evictions} evictions")
+        if any(r.get("spec_k") for r in pools):
+            drafted = sum(r.get("draft_tokens") or 0 for r in pools)
+            accepted = sum(r.get("accepted_tokens") or 0 for r in pools)
+            lines.append(
+                f"  speculation: k={p.get('spec_k')}, "
+                f"{accepted}/{drafted} draft tokens accepted")
     return lines
 
 

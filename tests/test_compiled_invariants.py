@@ -690,7 +690,7 @@ def test_decode_invariants():
 
 SERVING_NAMES = ("serve_tick", "serve_prefill", "serve_tick_int8fwd",
                  "serve_prefill_int8fwd", "serve_tick_paged",
-                 "serve_prefill_paged")
+                 "serve_prefill_paged", "serve_spec_tick")
 
 
 def serving_lowered(name: str):
@@ -698,7 +698,10 @@ def serving_lowered(name: str):
     scripts/capture_invariants.py — the recapture ritual covers the
     SERVING_NAMES). The ``*_paged`` pair (ISSUE 7) lowers the paged
     engine's steady-state programs — the pool-donated block-table tick
-    and the chunked prefill — at block 16 over a same-HBM pool."""
+    and the chunked prefill — at block 16 over a same-HBM pool;
+    ``serve_spec_tick`` (ISSUE 8) lowers the speculative draft-and-
+    verify tick (self-drafted, spec_k=4) over the same pool geometry —
+    BOTH pools donated, zero collectives."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -711,12 +714,13 @@ def serving_lowered(name: str):
         paged_slot_models,
         prefill_into_slot,
         slot_models,
+        spec_decode_tick,
     )
 
     slots, candidates, bucket = 4, 64, 128
     quant = "int8_fwd" if name.endswith("_int8fwd") else "none"
     model = GPT2(gpt2_config("test", quant=quant))
-    paged = name.endswith("_paged")
+    paged = name.endswith("_paged") or name == "serve_spec_tick"
     if paged:
         block, pages = 16, model.cfg.max_seq_len // 16
         tick_model, chunk_model = paged_slot_models(
@@ -744,6 +748,19 @@ def serving_lowered(name: str):
             sds(kd.shape, kd.dtype), sds((), i32),       # key, count
             sds((), f32), sds((), i32), sds((), f32),    # sampling params
             candidates=candidates)
+    if name == "serve_spec_tick":
+        # self-drafted: draft model/weights/cache mirror the target's —
+        # the pin still covers the two-pool donation + the fused
+        # rollout/verify/accept program shape
+        return spec_decode_tick.lower(
+            tick_model, tick_model, weights_sds, weights_sds,
+            cache_sds, cache_sds,
+            sds((slots, tick_model.cfg.kv_pages), i32),  # block tables
+            sds((slots,), i32),                          # lengths
+            sds((slots,), i32),                          # tokens
+            sds((slots,) + kd.shape, kd.dtype), sds((slots,), i32),
+            sds((slots,), f32), sds((slots,), i32), sds((slots,), f32),
+            spec_k=4, candidates=candidates)
     if name == "serve_tick_paged":
         return paged_decode_tick.lower(
             tick_model, weights_sds, cache_sds,
@@ -850,6 +867,29 @@ SERVE_COMMITTED: dict[str, dict] = {
         "temp_bytes": 969232,
         "arg_bytes": 736512,
         "alias_bytes": 270336,
+        "collectives": {"all-reduce": 0, "all-gather": 0,
+                        "reduce-scatter": 0, "collective-permute": 0,
+                        "all-to-all": 0, "ragged-all-to-all": 0,
+                        "collective-broadcast": 0},
+        "int8_ops": {"s8_values": 0, "int_dots": 0},
+        "comm_bytes": {"all-reduce": 0, "all-gather": 0,
+                       "reduce-scatter": 0, "collective-permute": 0,
+                       "all-to-all": 0, "ragged-all-to-all": 0,
+                       "collective-broadcast": 0},
+    },
+    # Speculative tick (ISSUE 8), captured 2026-08-04 on this image:
+    # alias_bytes 540672 == 2 x 270336 — BOTH donated pools (target +
+    # self-draft twin); if it halves, one cache stopped aliasing and
+    # every spec tick copies a whole pool. flops ~3.6x the plain paged
+    # tick (5 draft rollout steps + the k+1-wide verify vs one s=1
+    # apply) for up to spec_k+1=5 tokens emitted. Zero collectives:
+    # draft rollout, verify and the rejection kernel are all
+    # single-chip; a collective here is a per-token latency bug.
+    "serve_spec_tick": {
+        "flops": 6330606.0,
+        "temp_bytes": 1085760,
+        "arg_bytes": 1472768,
+        "alias_bytes": 540672,
         "collectives": {"all-reduce": 0, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
                         "all-to-all": 0, "ragged-all-to-all": 0,
