@@ -881,6 +881,171 @@ def bench_serve() -> dict:
     return result
 
 
+def _drive_router_trace(router, prompts, arrivals, max_new,
+                        on_step=None) -> list:
+    """Feed a seeded arrival trace to a ReplicaRouter in wall-clock time
+    and drain it; returns the request handles (shed ones included — the
+    shed rate is part of the measurement). ``on_step(router, reqs)``
+    runs once per loop iteration — the failover leg injects its
+    mid-trace kill there without duplicating the pacing logic."""
+    from pytorchdistributed_tpu.serving.router import DEAD
+
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, prompts))
+    reqs = []
+    while pending or router.queue_depth or router.in_flight:
+        if all(s == DEAD for s in router._status):
+            break  # whole fleet lost (1-replica kill leg): don't spin
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, p = pending.pop(0)
+            reqs.append(router.submit(p, max_new_tokens=max_new))
+        if on_step is not None:
+            on_step(router, reqs)
+        if router.queue_depth or router.in_flight:
+            router.step()
+        elif pending:
+            time.sleep(min(0.01, max(0.0, pending[0][0] - now)))
+    return reqs
+
+
+def bench_router() -> dict:
+    """Replicated serving (serving/ReplicaRouter, ISSUE 9): a seeded
+    Poisson trace over N in-process replicas, measured three ways.
+
+    1. BALANCE: the main trace runs with no faults; the stamp is the
+       per-replica mean-occupancy spread (max - min) — the
+       telemetry-driven dispatch should keep replicas within a few
+       occupancy points of each other.
+    2. FAILOVER: the same trace re-runs, and replica 0 is crashed once
+       PTD_ROUTER_KILL_FRAC of the requests have completed AND it holds
+       streams mid-flight; the stamp is ``failover_recovery_ticks`` /
+       ``_s`` (kill → every redispatched request streaming again) plus
+       the redispatch count, and ``unfinished_after_failover``
+       asserts-by-stamping (must be 0) that every request still
+       completed.
+    3. OVERLOAD: a burst of 2x the fleet's instantaneous capacity
+       (resident slots + dispatchable pending + the PTD_ROUTER_QUEUE
+       bound) lands at once; the stamps are ``shed_rate`` (substantial
+       — that's admission control working) and the ``ttft_ms_p99`` of
+       ADMITTED requests (bounded by construction instead of growing
+       with the line).
+
+    Knobs: PTD_ROUTER_{REPLICAS,SLOTS,REQUESTS,RATE,MAX_NEW,KILL_FRAC,
+    QUEUE}; PTD_QUANT rides the model config like every serving bench.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import ReplicaRouter
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    n_replicas = int(os.environ.get("PTD_ROUTER_REPLICAS", "2"))
+    num_slots = int(os.environ.get("PTD_ROUTER_SLOTS", "4"))
+    n_requests = int(os.environ.get("PTD_ROUTER_REQUESTS", "24"))
+    rate = float(os.environ.get("PTD_ROUTER_RATE", "16.0"))
+    max_new = int(os.environ.get("PTD_ROUTER_MAX_NEW", "16"))
+    kill_frac = float(os.environ.get("PTD_ROUTER_KILL_FRAC", "0.33"))
+    max_queue = int(os.environ.get("PTD_ROUTER_QUEUE", "6"))
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=256,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(17)
+    lens = rng.integers(8, 49, n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+               for m in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    ek = dict(num_slots=num_slots, prefill_bucket=64)
+
+    def build(**kw):
+        # every leg is a CONTROLLED measurement: chaos only ever comes
+        # from the leg's own kill, never an ambient PTD_FAULTS spec
+        kw.setdefault("faults", None)
+        r = ReplicaRouter(model, params, replicas=n_replicas,
+                          engine_kwargs=ek, warmup_lens=(64,), **kw)
+        r.warmup()
+        return r
+
+    # -- leg 1: balance --------------------------------------------------
+    router = build()
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    _drive_router_trace(router, prompts, arrivals, max_new)
+    s1 = router.summary()
+    recompiles = (sum(serving_engine.TRACE_COUNTS.values())
+                  - sum(traces0.values()))
+    router.close()
+
+    # -- leg 2: mid-trace kill ------------------------------------------
+    # the kill fires once the victim is genuinely mid-stream: after
+    # kill_frac of the trace has completed AND replica 0 holds work —
+    # killing an idle replica would stamp a recovery of nothing
+    router = build()
+    killed = [False]
+
+    def kill_mid_trace(r, reqs):
+        done = sum(1 for q in reqs if q.done)
+        if (not killed[0] and done >= kill_frac * n_requests
+                and r._assigned[0]):
+            r._replicas[0].apply_fault("replica_crash")
+            killed[0] = True
+
+    reqs = _drive_router_trace(router, prompts, arrivals, max_new,
+                               on_step=kill_mid_trace)
+    s2 = router.summary()
+    router.close()
+    unfinished = sum(1 for r in reqs
+                     if r.finish_reason not in ("length", "stop", "shed"))
+
+    # -- leg 3: 2x overload, bounded queue ------------------------------
+    # a burst of 2x what the fleet can hold at once (resident slots +
+    # dispatchable pending + the bounded queue): the shed rate IS the
+    # admission control working, and the admitted requests' TTFT p99
+    # stays bounded by construction instead of growing with the line
+    capacity = n_replicas * (num_slots + 1) + max_queue
+    n_over = 2 * capacity
+    over_prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+                    for m in rng.integers(8, 49, n_over)]
+    router = build(max_queue=max_queue)
+    _drive_router_trace(router, over_prompts, np.zeros(n_over), max_new)
+    s3 = router.summary()
+    router.close()
+
+    result = {
+        "metric": "router_failover_recovery_ticks",
+        "value": s2["failover_recovery_ticks"], "unit": "ticks",
+        "failover_recovery_s": s2["failover_recovery_s"],
+        "redispatched_requests": s2["redispatched_requests"],
+        "failovers": s2["failovers"],
+        "unfinished_after_failover": unfinished,  # must stamp 0
+        "replicas": n_replicas, "num_slots": num_slots,
+        "requests": n_requests, "arrival_rate_per_s": rate,
+        "occupancy_spread": s1["occupancy_spread"],
+        "replica_occupancy": s1["replica_occupancy"],
+        "served_by": s1["served_by"],
+        "recompiles": recompiles,
+        "ttft_ms_p50": s1.get("ttft_ms_p50"),
+        "ttft_ms_p99": s1.get("ttft_ms_p99"),
+        "overload": {
+            "burst": n_over, "capacity": capacity,
+            "max_queue": max_queue,
+            "shed_rate": s3["shed_rate"],
+            "shed_requests": s3["shed_requests"],
+            "admitted_ttft_ms_p99": s3.get("ttft_ms_p99"),
+        },
+    }
+    _stamp_overrides(result, ("PTD_ROUTER_REPLICAS", "PTD_ROUTER_SLOTS",
+                              "PTD_ROUTER_REQUESTS", "PTD_ROUTER_RATE",
+                              "PTD_ROUTER_MAX_NEW",
+                              "PTD_ROUTER_KILL_FRAC", "PTD_ROUTER_QUEUE",
+                              "PTD_QUANT"))
+    return result
+
+
 def bench_mlp() -> dict:
     import optax
 
@@ -1241,7 +1406,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
                metric="llama1b_s4096_train_tokens_per_s"),
            "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
-           "serve": bench_serve,
+           "serve": bench_serve, "router": bench_router,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 

@@ -159,6 +159,29 @@ _QUICK = (
     # seeded determinism, zero recompiles, telemetry columns) — all on
     # test-size models. The spec HLO pin rides test_serving_invariants.
     "test_spec.py",
+    # replica router chaos suite (ISSUE 9): fault-spec units, the
+    # resume-from-tokens engine satellite, crash-mid-stream bitwise
+    # parity (dense + paged), the hang watchdog bound, NaN quarantine +
+    # rejoin, overload shedding, SIGTERM drain, zero recompiles across
+    # a failover, seeded determinism across a failover, telemetry +
+    # report table — all in-process on the shared test-size engine
+    # geometry (the file rides test_serving/test_paging's compiles).
+    # The SUBPROCESS-mode test (spawns jax-importing workers) stays
+    # full-suite-only.
+    "test_router.py::test_serving_fault_specs_parse_and_fire_once",
+    "test_router.py::test_engine_resume_from_tokens_dense_and_paged",
+    "test_router.py::test_engine_resume_seeded_sampling_continues_stream",
+    "test_router.py::test_engine_health_snapshot_and_finite_probe",
+    "test_router.py::test_crash_midstream_greedy_bitwise_dense",
+    "test_router.py::test_crash_midstream_greedy_bitwise_paged",
+    "test_router.py::test_retry_budget_exhausted_fails_request",
+    "test_router.py::test_hang_detected_within_watchdog_bound",
+    "test_router.py::test_nan_replica_quarantined_then_rejoins_after_warmup",
+    "test_router.py::test_shed_under_overload_keeps_queue_bounded",
+    "test_router.py::test_sigterm_drain_finishes_resident_streams_no_orphans",
+    "test_router.py::test_zero_steadystate_recompiles_across_failover",
+    "test_router.py::test_seeded_sampling_determinism_across_failover",
+    "test_router.py::test_router_telemetry_rows_and_report_table",
 )
 
 

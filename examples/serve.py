@@ -51,6 +51,16 @@ def main():
                              "truncating the trained model to its first "
                              "N layers (0 = self-draft with the full "
                              "model, acceptance ~1)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="> 1: serve through the health-checked "
+                             "ReplicaRouter over this many in-process "
+                             "engine replicas (README 'Replicated "
+                             "serving & failover')")
+    parser.add_argument("--chaos", action="store_true",
+                        help="with --replicas > 1: crash replica 0 "
+                             "mid-trace — watch the router redispatch "
+                             "its streams to a survivor with the SAME "
+                             "tokens")
     args = parser.parse_args()
     if args.spec_k and not args.block_size:
         args.block_size = 16  # spec requires the paged engine
@@ -80,6 +90,62 @@ def main():
         draft, draft_params = truncated_draft(model, params,
                                               args.draft_layers)
         spec_kw = dict(draft_config=draft.cfg, draft_params=draft_params)
+
+    if args.replicas > 1:
+        # REPLICATED serving (ISSUE 9): the router owns N engines,
+        # balances on their health snapshots and — with --chaos — shows
+        # lossless mid-stream failover: the crashed replica's streams
+        # resume on a survivor with identical tokens
+        from pytorchdistributed_tpu.serving import ReplicaRouter
+
+        # no --chaos: leave the router's default ("auto") so the
+        # PTD_FAULTS env contract keeps working through the demo
+        router_kw = {}
+        if args.chaos:
+            # the supported chaos contract — the same spec syntax
+            # `run.py --faults` / PTD_FAULTS accept; the router fires
+            # it at its own tick counter (one submit = one tick here,
+            # so this kills replica 0 mid-trace)
+            from pytorchdistributed_tpu.faults import (
+                FaultInjector,
+                FaultPlan,
+            )
+
+            spec = (f"replica_crash@tick={max(2, args.requests // 2)},"
+                    f"replica=0")
+            print(f"--- chaos armed: {spec} ---")
+            router_kw["faults"] = FaultInjector(FaultPlan.parse(spec))
+        router = ReplicaRouter(
+            model, params, replicas=args.replicas,
+            engine_kwargs=dict(num_slots=args.num_slots,
+                               prefill_bucket=16,
+                               block_size=args.block_size,
+                               spec_k=args.spec_k, **spec_kw),
+            warmup_lens=(16,), telemetry_dir=args.telemetry_dir,
+            **router_kw)
+        router.warmup()
+        router.install_sigterm_drain()
+        reqs = []
+        for i in range(args.requests):
+            prompt = rng.integers(1, cfg.vocab_size,
+                                  (int(rng.integers(3, 12)),)
+                                  ).astype(np.int32)
+            sampling = (SamplingParams() if i % 2 == 0 else
+                        SamplingParams(temperature=0.7, top_k=8, seed=i))
+            reqs.append(router.submit(prompt, max_new_tokens=8,
+                                      sampling=sampling))
+            router.step()
+        router.run_until_idle()
+        for r in reqs:
+            hops = "->".join(map(str, r.replicas))
+            print(f"req {r.id} (replica {hops}, {r.finish_reason}, "
+                  f"retries {r.retries}): "
+                  f"{r.prompt.tolist()} -> {r.tokens}")
+        print("router summary:", router.summary())
+        router.close()
+        ptd.destroy_process_group()
+        return
+
     engine = ServingEngine(
         model, params,
         num_slots=args.num_slots, prefill_bucket=16,

@@ -35,7 +35,17 @@ data loader, and the checkpoint save path already call:
     probability P (tail-latency injection);
   * ``io_err[@p=P][,n=N][,rank=R]`` — I/O hooks raise OSError with
     probability P, at most N times total (N=0 → uncapped): the transient
-    class ``faults.retry`` must absorb.
+    class ``faults.retry`` must absorb;
+  * ``replica_crash@tick=T[,replica=I]`` / ``replica_hang@tick=T[...]``
+    / ``replica_nan@tick=T[...]`` — SERVING faults (ISSUE 9), fired by
+    the replica router's scheduler loop at router tick T against
+    replica I (any replica when omitted): crash kills the replica
+    mid-stream, hang freezes it without exiting (the progress-watermark
+    watchdog must catch it), nan poisons its params so the
+    engine-health tripwire declares it sick and the router quarantines
+    it. The router redispatches the victim's in-flight requests to
+    survivors — `serving/router.py` owns the application, this module
+    owns the schedule.
 
 Every injection emits a TelemetryEvent before it acts, so the launcher's
 per-incarnation summaries show *why* an incarnation died. Step-targeted
@@ -74,7 +84,17 @@ CRASH_EXIT_CODE = 41
 
 _STEP_KINDS = ("crash", "hang", "preempt", "nan")
 _IO_KINDS = ("slow_io", "io_err")
-KINDS = frozenset(_STEP_KINDS + _IO_KINDS + ("ckpt_corrupt",))
+#: Serving-phase faults (ISSUE 9): fired by the replica ROUTER's tick
+#: loop (serving/router.py), targeted at a replica index instead of a
+#: rank — `replica_crash@tick=5,replica=0; replica_hang@tick=9` etc.
+#: crash kills the replica mid-stream (in-process: the engine raises and
+#: is torn down; subprocess: os._exit), hang freezes it silently (the
+#: progress-watermark analog of the SIGSTOP training hang), nan poisons
+#: its PARAMS so the engine-health tripwire (params_finite) must declare
+#: it sick and the router quarantine it.
+_SERVING_KINDS = ("replica_crash", "replica_hang", "replica_nan")
+KINDS = frozenset(_STEP_KINDS + _IO_KINDS + _SERVING_KINDS
+                  + ("ckpt_corrupt",))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,13 +109,19 @@ class FaultSpec:
     n: int = 0
     code: int = CRASH_EXIT_CODE
     layer: int | None = None    # nan only: poison THIS layer's params
+    tick: int | None = None     # serving faults: fire at router tick T
+    replica: int | None = None  # serving faults: target replica index
 
     def describe(self) -> str:
         parts = [self.kind]
         if self.step is not None:
             parts.append(f"step={self.step}")
+        if self.tick is not None:
+            parts.append(f"tick={self.tick}")
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
+        if self.replica is not None:
+            parts.append(f"replica={self.replica}")
         if self.layer is not None:
             parts.append(f"layer={self.layer}")
         return parts[0] + ("@" + ",".join(parts[1:]) if parts[1:] else "")
@@ -131,7 +157,8 @@ class FaultPlan:
                 key, _, val = item.partition("=")
                 key, val = key.strip(), val.strip()
                 try:
-                    if key in ("step", "rank", "n", "code", "layer"):
+                    if key in ("step", "rank", "n", "code", "layer",
+                               "tick", "replica"):
                         kw[key] = int(val)
                     elif key in ("p", "ms"):
                         kw[key] = float(val)
@@ -147,6 +174,14 @@ class FaultPlan:
             if kind in _STEP_KINDS and "step" not in kw:
                 raise ValueError(
                     f"fault {kind!r} needs step= (got {entry!r})")
+            if kind in _SERVING_KINDS and "tick" not in kw:
+                raise ValueError(
+                    f"fault {kind!r} needs tick= (got {entry!r})")
+            if (("tick" in kw or "replica" in kw)
+                    and kind not in _SERVING_KINDS):
+                raise ValueError(
+                    f"tick=/replica= only apply to serving faults "
+                    f"({', '.join(_SERVING_KINDS)}; got {entry!r})")
             if "p" in kw and not 0.0 <= kw["p"] <= 1.0:
                 raise ValueError(f"p must be in [0, 1], got {kw['p']}")
             specs.append(FaultSpec(kind=kind, **kw))
@@ -279,6 +314,37 @@ class FaultInjector:
                     f"NaN at step {step}\n")
                 sys.stderr.flush()
                 return spec.layer
+        return None
+
+    def on_serving_tick(self, tick: int, replica: int) -> str | None:
+        """Serving-phase hook (ISSUE 9), called by the replica router
+        (or a subprocess replica worker) once per scheduler tick per
+        replica BEFORE that replica steps. Returns the fault kind to
+        apply to this replica at this tick — ``"replica_crash"`` /
+        ``"replica_hang"`` / ``"replica_nan"`` — or None. The CALLER
+        applies it (an in-process replica cannot os._exit the router);
+        one-shot markers keep a tick-targeted fault from re-firing, and
+        every firing emits a TelemetryEvent first, so the run dir says
+        why a replica died."""
+        for i, spec in enumerate(self.plan.specs):
+            if (spec.kind not in _SERVING_KINDS or spec.tick != tick
+                    or (spec.replica is not None
+                        and spec.replica != replica)):
+                continue
+            # replica= omitted means ANY replica — ONE victim (the
+            # first consult at tick T), so the marker must not be
+            # per-replica or an untargeted crash would kill the fleet
+            marker = (f"{i}_{spec.kind}@{spec.tick}"
+                      + (f"_r{replica}" if spec.replica is not None
+                         else ""))
+            if not self._once(marker):
+                continue
+            self._emit(spec, step=tick, replica=replica)
+            sys.stderr.write(
+                f"[faults] injected {spec.kind} on replica {replica} at "
+                f"serving tick {tick}\n")
+            sys.stderr.flush()
+            return spec.kind
         return None
 
     def on_io(self, what: str, *, step: int = -1) -> None:

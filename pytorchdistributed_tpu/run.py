@@ -91,7 +91,10 @@ from pytorchdistributed_tpu.telemetry.events import (
 )
 
 
-def _free_port() -> int:
+def free_port() -> int:
+    """An OS-assigned free localhost port (the MASTER_PORT of the env
+    contract). Public: the serving replica router's subprocess mode
+    reuses the same rendezvous contract for its workers."""
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
@@ -128,13 +131,15 @@ def _spawn_group(argv, nproc: int, port: int,
     return procs
 
 
-def _kill_group(procs, *, sig: int = signal.SIGTERM,
-                grace: float = 10.0) -> None:
+def kill_group(procs, *, sig: int = signal.SIGTERM,
+               grace: float = 10.0) -> None:
     """Signal every live worker and SIGKILL stragglers after ``grace``
     seconds. The default (SIGTERM, 10 s) is the failure-teardown path; the
     agent's signal forwarding reuses it with the received signal and
     ``--preempt-grace`` so Trainers get one window to drain durable
-    checkpoints — one escalation point, not two."""
+    checkpoints — one escalation point, not two. Public: the serving
+    replica router's subprocess teardown uses the same escalation so a
+    drained router can never leave an orphan replica worker."""
     for p in procs:
         if p.poll() is None:
             p.send_signal(sig)
@@ -155,7 +160,7 @@ def _forward_signal_and_drain(procs, signum: int, grace: float) -> None:
     must reach the Trainers (SIGINT is translated to SIGTERM, the signal
     their preemption handler owns)."""
     fwd = signal.SIGTERM if signum == signal.SIGINT else signum
-    _kill_group(procs, sig=fwd, grace=grace)
+    kill_group(procs, sig=fwd, grace=grace)
 
 
 def main(argv=None) -> int:
@@ -270,7 +275,7 @@ def _main(argv, owned_dirs: list[str]) -> int:
               "to observe a repeated failure; it will never fire",
               file=sys.stderr)
     while True:
-        port = _free_port()
+        port = free_port()
         # fresh heartbeat dir per incarnation: a relaunch must not inherit
         # the dead group's file mtimes
         hb_dir = (tempfile.mkdtemp(prefix="ptd_heartbeat_")
@@ -354,10 +359,10 @@ def _main(argv, owned_dirs: list[str]) -> int:
                                     now=time.time(), baseline=spawned_at)
                 failed = sorted(set(r for r in stale if codes[r] is None)
                                 | set(exited))
-        # Snapshot BEFORE the teardown: _kill_group can block ~10s on a
+        # Snapshot BEFORE the teardown: kill_group can block ~10s on a
         # SIGTERM-ignoring worker, and that wait is not health either.
         detected_at = time.time()
-        _kill_group(procs)
+        kill_group(procs)
         # aggregate this incarnation's tripwire events next to the
         # failure attribution below (NaN storms and loss spikes are the
         # why behind many a nonzero exit)

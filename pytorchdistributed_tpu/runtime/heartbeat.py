@@ -84,6 +84,22 @@ class Heartbeat:
             t.join(timeout=interval + 1.0)
 
 
+def last_beat_age(path: str | os.PathLike, *,
+                  now: float | None = None) -> float | None:
+    """Seconds since the liveness file at ``path`` was last touched,
+    or None when it has never beaten. The single-file complement of
+    ``stale_ranks`` for callers that watch ONE worker (the serving
+    replica router surfaces this per subprocess replica next to its
+    protocol-level progress watermark)."""
+    import time
+
+    try:
+        last = Path(path).stat().st_mtime
+    except OSError:
+        return None
+    return max(0.0, (now if now is not None else time.time()) - last)
+
+
 def stale_ranks(directory: str | os.PathLike, nproc: int, *, timeout: float,
                 grace: float, now: float, baseline: float) -> list[int]:
     """Agent-side check: ranks in [0, nproc) whose last beat is older than

@@ -16,7 +16,14 @@
   * telemetry.py — ServingTelemetry: TTFT / tokens-per-s / queue depth /
                    slot occupancy / prefix-cache + block-pool metrics as
                    spans + metric JSONL through the existing telemetry/
-                   package
+                   package; RouterTelemetry: the router's per-replica /
+                   event / summary JSONL stream
+  * router.py    — ReplicaRouter (ISSUE 9): health-checked router over
+                   N engine replicas (in-process or run.py-env-contract
+                   subprocess workers) with lossless mid-stream
+                   failover, load shedding, quarantine/rejoin and
+                   graceful SIGTERM drain; replica_worker.py is the
+                   subprocess side
 
 `bench.py --mode serve` drives it under a Poisson arrival trace (plus
 the paged capacity and prefix-reuse A/Bs); examples/serve.py is the
@@ -39,8 +46,21 @@ from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
     BlockAllocator,
     RadixPrefixCache,
 )
+from pytorchdistributed_tpu.serving.router import (  # noqa: F401
+    DEAD,
+    HEALTHY,
+    QUARANTINED,
+    InProcessReplica,
+    ReplicaCrashed,
+    ReplicaRouter,
+    RouterRequest,
+    SubprocessReplica,
+)
 from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
+    ROUTER_METRICS_FILE,
+    ROUTER_METRICS_GLOB,
     SERVE_METRICS_FILE,
     SERVE_METRICS_GLOB,
+    RouterTelemetry,
     ServingTelemetry,
 )

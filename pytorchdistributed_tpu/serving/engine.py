@@ -145,7 +145,7 @@ def decode_tick(model, weights, cache, tokens, key_data, counts,
     static_argnames=("model", "candidates"),
     donate_argnames=("cache",))
 def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
-                      key_data, temperature, top_k, top_p, *,
+                      key_data, count, temperature, top_k, top_p, *,
                       candidates: int):
     """Admit one request: a chunked prompt forward (batch 1, prompt
     right-padded to the bucket length — ``true_len`` is dynamic) fills a
@@ -155,14 +155,18 @@ def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
     until decode overwrites them — the same trick as
     inference.generate_bucketed). Returns (cache, first_token): sampling
     the first token here is what makes TTFT one prefill, not
-    prefill + a decode tick."""
+    prefill + a decode tick. ``count`` is the sampled token's fold_in
+    index — 0 on a fresh admission, the generated-so-far length when a
+    request RESUMES from tokens (submit(generated=...) — the router's
+    failover path), so a resumed sampled stream continues its seeded
+    PRNG sequence exactly where the dead replica left it."""
     TRACE_COUNTS["prefill"] += 1
     fresh = _zero_cache(model, prompt)
     logits, mut = model.apply({"params": weights, "cache": fresh}, prompt,
                               mutable=["cache"])
     last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)
     keys = jax.random.wrap_key_data(key_data[None])
-    subs = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((1,), jnp.int32))
+    subs = jax.vmap(jax.random.fold_in)(keys, count[None])
     first = sample_slots(last[:, 0].astype(jnp.float32), subs,
                          temperature[None], top_k[None], top_p[None],
                          candidates=candidates)[0]
@@ -356,6 +360,35 @@ def spec_decode_tick(model, draft_model, weights, draft_weights, cache,
         spec_k=spec_k, candidates=candidates)
 
 
+def nan_params(weights):
+    """Every inexact leaf replaced with NaN — the serving chaos twin of
+    the training ``nan@step`` fault, shared by the in-process replica
+    and the subprocess worker so both chaos modes poison IDENTICALLY
+    (params_finite is the tripwire that must catch either)."""
+    return jax.tree_util.tree_map(
+        lambda x: (jnp.full_like(x, jnp.nan)
+                   if jnp.issubdtype(x.dtype, jnp.inexact) else x),
+        weights)
+
+
+@jax.jit
+def params_finite(weights):
+    """ONE device scalar answering "are these params all finite?" — the
+    engine-health tripwire the replica router polls (a NaN'd replica
+    must be declared sick from its *params*, not inferred from garbage
+    token ids, which stay perfectly finite ints). One reduction per
+    leaf + a stacked all(): cheap enough to run every few ticks, and a
+    separate compiled program, so the committed tick/prefill HLO pins
+    never move."""
+    TRACE_COUNTS["params_finite"] += 1
+    leaves = [jnp.all(jnp.isfinite(x))
+              for x in jax.tree_util.tree_leaves(weights)
+              if jnp.issubdtype(x.dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.all(jnp.stack(leaves))
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs (dynamic per slot — any mix of requests
@@ -378,7 +411,8 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens: int,
                  sampling: SamplingParams, stop_ids: tuple[int, ...],
-                 on_token=None, deadline_s: float | None = None):
+                 on_token=None, deadline_s: float | None = None,
+                 generated=None):
         self.id = next(Request._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = max_new_tokens
@@ -386,7 +420,14 @@ class Request:
         self.stop_ids = stop_ids
         self.on_token = on_token
         self.deadline_s = deadline_s
-        self.new_tokens: list[int] = []
+        # resume-from-tokens (the router's failover redispatch): the
+        # stream's already-generated suffix is pre-seeded, so admission
+        # re-prefills prompt+generated and the engine only ever DELIVERS
+        # tokens past ``resumed_from`` — on_token never re-fires for
+        # tokens the client already has
+        self.new_tokens: list[int] = ([int(t) for t in generated]
+                                      if generated is not None else [])
+        self.resumed_from = len(self.new_tokens)
         self.slot: int | None = None
         self.done = False
         self.finish_reason: str | None = None
@@ -428,7 +469,9 @@ class Request:
         if self.finish_time is None or self.first_token_time is None:
             return None
         dt = self.finish_time - self.first_token_time
-        n = len(self.new_tokens) - 1
+        # resumed tokens were generated elsewhere — only tokens THIS
+        # engine decoded belong in its rate
+        n = len(self.new_tokens) - self.resumed_from - 1
         if n <= 0 or dt <= 0:
             return None
         return round(n / dt, 3)
@@ -614,6 +657,15 @@ class ServingEngine:
         self._queue: collections.deque[Request] = collections.deque()
         self._active: dict[int, Request] = {}
         self._draining = False
+        # health-snapshot state (ISSUE 9): ``_progress`` is a MONOTONIC
+        # device-work watermark (never reset by reset_stats) — it moves
+        # exactly when a compiled call completed and synced, so a router
+        # watching it can tell a hung replica from an idle one; the TTFT
+        # EMA is the router's load-balancing latency signal; ``_sick``
+        # holds the last params-finite probe verdict
+        self._progress = 0
+        self._ttft_ema: float | None = None
+        self._sick = False
         if telemetry is None and telemetry_dir is not None:
             telemetry = ServingTelemetry(telemetry_dir)
         self.telemetry = telemetry
@@ -624,7 +676,8 @@ class ServingEngine:
 
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None, stop_ids=None,
-               on_token=None, deadline_s: float | None = None) -> Request:
+               on_token=None, deadline_s: float | None = None,
+               generated=None) -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
         steps). ``stop_ids`` accepts a single id or a sequence.
@@ -634,7 +687,18 @@ class ServingEngine:
         slot is freed for the next arrival; the other slots are never
         disturbed. The robustness knob a serving tier needs under
         overload — a stuck client budget must shed, not wedge, the
-        engine."""
+        engine.
+
+        ``generated`` resumes a stream FROM TOKENS (the replica
+        router's mid-stream failover, ISSUE 9): admission re-prefills
+        prompt+generated — the exact mechanism the paged engine's
+        preempt-requeue already uses, factored up to the public API —
+        and decoding continues with the per-token fold_in count at
+        ``len(generated)``, so the continuation is bitwise what the
+        uninterrupted run would have produced (greedy AND seeded
+        sampling). ``max_new_tokens`` still bounds the TOTAL new-token
+        stream, generated prefix included; only tokens past it are
+        delivered/streamed."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -643,6 +707,11 @@ class ServingEngine:
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if deadline_s is not None and deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        if generated is not None and len(generated) >= max_new_tokens:
+            raise ValueError(
+                f"generated carries {len(generated)} tokens but "
+                f"max_new_tokens is {max_new_tokens} — nothing left to "
+                f"resume")
         if prompt.size + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt_len {prompt.size} + max_new_tokens "
@@ -650,7 +719,7 @@ class ServingEngine:
                 f"{self.cfg.max_seq_len}")
         req = Request(prompt, max_new_tokens, sampling or SamplingParams(),
                       stop_ids_tuple(stop_ids), on_token,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, generated=generated)
         req.submit_time = time.perf_counter()
         self._queue.append(req)
         return req
@@ -703,6 +772,7 @@ class ServingEngine:
                 toks = np.asarray(nxt)  # host sync: streaming delivery
             dt = time.perf_counter() - t0
             self._counts += 1
+            self._progress += 1
             st = self._stats
             st["ticks"] += 1
             st["tick_s"] += dt
@@ -754,6 +824,7 @@ class ServingEngine:
             ns = np.asarray(nacc)
         dt = time.perf_counter() - t0
         n_active = len(self._active)
+        self._progress += 1
         st["ticks"] += 1
         st["tick_s"] += dt
         st["occupancy_sum"] += n_active / self.num_slots
@@ -950,6 +1021,7 @@ class ServingEngine:
                     self._draft_cache, pf, pf["dpos"])
                 pf["dpos"] += self.chunk
         now = time.perf_counter()
+        self._progress += 1
         st = self._stats
         st["prefill_s"] += now - t0
         st["prefill_chunks"] += 1
@@ -973,7 +1045,7 @@ class ServingEngine:
         if req.first_token_time is None:
             req.first_token_time = now
             if req.submit_time is not None:
-                st["ttft_s"].append(now - req.submit_time)
+                self._note_ttft(now - req.submit_time)
         self._active[slot] = req
         self._admit_order[slot] = next(self._admit_seq)
         self._key_data[slot] = pf["kd"]
@@ -1076,8 +1148,10 @@ class ServingEngine:
     def stream(self, req: Request):
         """Iterator over one request's tokens, stepping the engine (and
         every other resident request) as needed — the single-consumer
-        streaming shape; concurrent consumers share the same step()s."""
-        sent = 0
+        streaming shape; concurrent consumers share the same step()s.
+        Starts past any resume-from-tokens prefix: the client already
+        holds those tokens (submit's delivery contract)."""
+        sent = req.resumed_from
         while True:
             while sent < len(req.new_tokens):
                 yield req.new_tokens[sent]
@@ -1104,6 +1178,14 @@ class ServingEngine:
             n = max(1, min(n, self.cfg.max_seq_len - max_new_tokens))
             self.submit(np.zeros(n, np.int32), max_new_tokens=max_new_tokens)
             self.run_until_idle()
+        # warm the health probe too: a router polling
+        # check_params_finite() must find it compiled, or the first
+        # steady-state health check pays a trace
+        self.check_params_finite()
+        # warmup TTFTs include COMPILES — a router balancing on the
+        # TTFT EMA would permanently shun whichever replica compiled
+        # first (the others warm from the shared jit cache in ms)
+        self._ttft_ema = None
         if self.paged and self._radix is not None:
             self._radix.clear()  # don't serve real traffic warmup zeros
             self._radix.reset_stats()
@@ -1192,11 +1274,19 @@ class ServingEngine:
 
     def _admit(self, req: Request) -> None:
         slot = self._free.pop()
-        n = req.prompt.size
+        # a resume-from-tokens submit (router failover) prefills
+        # prompt + already-generated — the dense twin of the paged
+        # engine's preempt-requeue re-prefill; the continuation token is
+        # sampled with fold_in count == resume so seeded streams pick up
+        # exactly where they stopped
+        tokens = np.concatenate(
+            [req.prompt, np.asarray(req.new_tokens, np.int32)])
+        n = int(tokens.size)
+        resume = len(req.new_tokens)
         padded_len = min(-(-n // self.bucket) * self.bucket,
                          self.cfg.max_seq_len)
         padded = np.zeros((1, padded_len), np.int32)
-        padded[0, :n] = req.prompt
+        padded[0, :n] = tokens
         kd = np.asarray(jax.random.key_data(
             jax.random.key(req.sampling.seed)))
         t0 = time.perf_counter()
@@ -1204,23 +1294,25 @@ class ServingEngine:
             self._cache, first = prefill_into_slot(
                 self._prefill_model, self._weights, self._cache,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
-                jnp.asarray(kd),
+                jnp.asarray(kd), jnp.int32(resume),
                 jnp.float32(req.sampling.temperature),
                 jnp.int32(req.sampling.top_k),
                 jnp.float32(req.sampling.top_p),
                 candidates=self.candidates)
             first = int(first)  # sync: the TTFT timestamp is honest
         now = time.perf_counter()
+        self._progress += 1
         st = self._stats
         st["prefills"] += 1
         st["prefill_s"] += now - t0
         req.slot = slot
-        req.first_token_time = now
-        if req.submit_time is not None:
-            st["ttft_s"].append(now - req.submit_time)
+        if req.first_token_time is None:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                self._note_ttft(now - req.submit_time)
         self._active[slot] = req
         self._key_data[slot] = kd
-        self._counts[slot] = 1  # token n samples with fold_in(key, n)
+        self._counts[slot] = resume + 1  # token n samples fold_in(key, n)
         self._temps[slot] = req.sampling.temperature
         self._top_ks[slot] = req.sampling.top_k
         self._top_ps[slot] = req.sampling.top_p
@@ -1255,6 +1347,75 @@ class ServingEngine:
             self._stats["deadline_expired"] += 1
         if self.telemetry is not None:
             self.telemetry.request(req)
+
+    def _note_ttft(self, dt: float) -> None:
+        self._stats["ttft_s"].append(dt)
+        self._ttft_ema = (dt if self._ttft_ema is None
+                          else 0.8 * self._ttft_ema + 0.2 * dt)
+
+    # ------------------------------------------------------------------
+    # health (ISSUE 9): the snapshot the replica router polls
+
+    def health(self) -> dict:
+        """One host-side health/load snapshot — NO device work (the
+        params-finite probe is ``check_params_finite``, priced
+        separately so the router chooses its cadence):
+
+          * ``progress`` — monotonic count of completed compiled calls
+            (ticks + prefills + chunks). A replica with work whose
+            watermark stops moving is hung (the serving analog of
+            runtime/heartbeat.py's device-sync rule: every increment
+            sits after a host sync of device results, so it can't be
+            the async-dispatch illusion);
+          * ``occupancy`` / ``queued`` / ``free_slots`` /
+            ``prefilling`` — the load-balancing signals;
+          * ``pool_free_frac`` — paged pool headroom (1.0 dense);
+          * ``ttft_ema_s`` — smoothed recent time-to-first-token;
+          * ``sick`` — the last params-finite probe verdict (True
+            after a NaN poisoning until the probe passes again)."""
+        free_frac = 1.0
+        if self.paged:
+            free_frac = self._alloc.free_count / max(1, self._alloc.usable)
+        return {
+            "alive": True,
+            "progress": self._progress,
+            "active": len(self._active),
+            "queued": len(self._queue),
+            "free_slots": len(self._free),
+            "prefilling": self.prefilling_count,
+            "num_slots": self.num_slots,
+            "occupancy": len(self._active) / self.num_slots,
+            "pool_free_frac": round(free_frac, 4),
+            "ttft_ema_s": self._ttft_ema,
+            "sick": self._sick,
+        }
+
+    def check_params_finite(self) -> bool:
+        """Run the compiled params-finite probe (one scalar sync) and
+        record the verdict in ``health()['sick']``. False = this
+        replica's weights carry NaN/Inf — every token it emits is
+        garbage and a router must quarantine it."""
+        with self._mesh_ctx():
+            ok = bool(params_finite(self._weights))
+        self._sick = not ok
+        return ok
+
+    def set_params(self, params) -> None:
+        """Swap the serving weights in place (same treedef — the
+        compiled programs retrace on a structure change, never on new
+        values). The quarantine/rejoin path: an operator repairs a
+        NaN'd replica by reloading a verified checkpoint here, then the
+        router's warmup re-admission probes it healthy again."""
+        self._weights = params["params"] if "params" in params else params
+
+    def invalidate_prefix_cache(self) -> None:
+        """Drop every radix-cached prefix block (refcounts released; a
+        block still referenced by a resident slot survives until that
+        slot retires). A rejoining quarantined replica must do this:
+        blocks cached while its params were NaN hold poisoned K/V that
+        a future prefix hit would serve as truth."""
+        if self.paged and self._radix is not None:
+            self._radix.clear()
 
     # ------------------------------------------------------------------
     # stats

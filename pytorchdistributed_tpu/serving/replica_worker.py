@@ -1,0 +1,220 @@
+"""Subprocess serving replica — the worker half of ReplicaRouter's
+multi-process mode (ISSUE 9).
+
+    PTD_REPLICA_SPEC='{"model": "gpt2", "size": "test", ...}' \
+    RANK=0 WORLD_SIZE=2 python -m pytorchdistributed_tpu.serving.replica_worker
+
+Reads the same env contract run.py gives training workers (RANK is the
+replica index; MASTER_* ride along for future cross-replica state) plus
+a JSON ``PTD_REPLICA_SPEC`` describing the model/engine to build, then
+serves a line-JSON protocol on stdin/stdout — one response per op:
+
+    {"op": "warmup", "prompt_lens": [16]}        -> {"ok": true}
+    {"op": "submit", "rid": 3, "prompt": [...], ...} -> {"ok": true}
+    {"op": "step"}   -> {"ok": true, "delivered": [[rid, tok], ...],
+                         "finished": [[rid, reason], ...],
+                         "health": {...}}
+    {"op": "probe"}  -> {"finite": true}
+    {"op": "drain"}  -> {"ok": true, "finished": [...]}
+    {"op": "close"}  -> {"ok": true}  (then exits 0)
+
+Liveness: PTD_HEARTBEAT_DIR (the run.py contract) gets a beat after
+every step op — each beat follows the engine's host sync of device
+results, honoring runtime/heartbeat.py's device-sync rule. SIGTERM
+drains the engine and exits 0 (the router forwards it on teardown;
+kill_group escalation covers a wedged worker). PTD_FAULTS serving
+faults fire HERE, against this worker's own RANK: ``replica_crash``
+os._exits mid-protocol, ``replica_hang`` SIGSTOPs (alive, silent — the
+router's watchdog must catch it), ``replica_nan`` NaNs the params so
+the router's probe op must come back non-finite.
+
+The spec: {"model": "gpt2"|"llama", "size": "test", "overrides": {...
+TransformerConfig overrides}, "init_seed": 1, "engine": {...
+ServingEngine kwargs}, "max_seq_len": ...}. Params are INITIALIZED from
+init_seed — deterministic across replicas without shipping weights over
+a pipe; a real deployment points "checkpoint" at a restore path
+instead (TODO alongside the ROADMAP 5 AOT cache).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+
+def _build_engine(spec: dict):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import (
+        GPT2,
+        Llama,
+        gpt2_config,
+        llama_config,
+    )
+    from pytorchdistributed_tpu.serving.engine import ServingEngine
+    from pytorchdistributed_tpu.serving.telemetry import ServingTelemetry
+
+    kind = spec.get("model", "gpt2")
+    size = spec.get("size", "test")
+    overrides = dict(spec.get("overrides", {}))
+    if kind == "llama":
+        cfg = llama_config(size, **overrides)
+        model = Llama(cfg)
+    else:
+        cfg = gpt2_config(size, **overrides)
+        model = GPT2(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(int(spec.get("init_seed", 0))),
+        jnp.zeros((1, 8), jnp.int32))
+    telemetry = ServingTelemetry.from_env()
+    return ServingEngine(model, params, telemetry=telemetry,
+                         **spec.get("engine", {}))
+
+
+def main() -> int:
+    spec = json.loads(os.environ.get("PTD_REPLICA_SPEC", "{}"))
+    rank = int(os.environ.get("RANK", "0"))
+
+    from pytorchdistributed_tpu.faults.inject import FaultInjector
+    from pytorchdistributed_tpu.runtime.heartbeat import Heartbeat
+
+    engine = _build_engine(spec)
+    heartbeat = Heartbeat.from_env()
+    injector = FaultInjector.from_env()
+    delivered: list[list[int]] = []
+    finished: list[list] = []
+    reqs: dict[int, object] = {}
+
+    def on_token(req, tok):
+        delivered.append([req.router_rid, int(tok)])
+
+    def sweep_finished() -> None:
+        for rid, req in list(reqs.items()):
+            if req.done:
+                finished.append([rid, req.finish_reason])
+                del reqs[rid]
+
+    def reply(**payload) -> None:
+        sys.stdout.write(json.dumps(payload) + "\n")
+        sys.stdout.flush()
+
+    # SIGTERM must work while BLOCKED in the stdin read (the idle
+    # worker's steady state — PEP 475 would otherwise retry the read
+    # after a flag-setting handler and the drain would wait for the
+    # next op that never comes): raise out of the read and let the
+    # finally-drain run. Raising between ops is safe — the engine is
+    # only ever mutated inside a fully-completed op handler.
+    def _sigterm(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    closed = [False]
+
+    def shutdown() -> None:
+        if not closed[0]:
+            closed[0] = True
+            engine.drain()
+            engine.close()
+
+    try:
+        return _serve(engine, heartbeat, injector, rank, delivered,
+                      finished, reqs, on_token, sweep_finished, reply,
+                      shutdown)
+    finally:
+        # every exit path — close op, stdin EOF, SIGTERM — drains the
+        # engine (pool-leak invariant asserted) exactly once
+        shutdown()
+
+
+def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
+           on_token, sweep_finished, reply, shutdown) -> int:
+    tick = 0
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        op = json.loads(line)
+        kind = op.get("op")
+        if kind == "warmup":
+            engine.warmup(prompt_lens=op.get("prompt_lens") or None)
+            # report the real context bound so the router can validate
+            # submits against it instead of trusting the spec
+            reply(ok=True, max_seq_len=engine.cfg.max_seq_len)
+        elif kind == "submit":
+            s = op.get("sampling", {})
+            from pytorchdistributed_tpu.serving.engine import (
+                SamplingParams,
+            )
+            try:
+                req = engine.submit(
+                    op["prompt"], max_new_tokens=op["max_new_tokens"],
+                    sampling=SamplingParams(
+                        temperature=float(s.get("temperature", 0.0)),
+                        top_k=int(s.get("top_k", 0)),
+                        top_p=float(s.get("top_p", 1.0)),
+                        seed=int(s.get("seed", 0))),
+                    stop_ids=tuple(op.get("stop_ids") or ()),
+                    deadline_s=op.get("deadline_s"),
+                    generated=op.get("generated") or None,
+                    on_token=on_token)
+            except ValueError as e:
+                # a malformed request must cost ONE refusal, not the
+                # worker process (and then, replica by replica, the
+                # fleet as the router redispatches it)
+                reply(ok=False, rid=op["rid"], error=str(e))
+                continue
+            req.router_rid = op["rid"]
+            reqs[op["rid"]] = req
+            reply(ok=True, rid=op["rid"])
+        elif kind == "step":
+            tick += 1
+            if injector is not None:
+                fault = injector.on_serving_tick(tick, rank)
+                if fault == "replica_crash":
+                    from pytorchdistributed_tpu.faults.inject import (
+                        CRASH_EXIT_CODE,
+                    )
+
+                    sys.stdout.flush()
+                    os._exit(CRASH_EXIT_CODE)
+                elif fault == "replica_hang":
+                    os.kill(os.getpid(), signal.SIGSTOP)
+                elif fault == "replica_nan":
+                    from pytorchdistributed_tpu.serving.engine import (
+                        nan_params,
+                    )
+
+                    engine.set_params(nan_params(engine._weights))
+            engine.step()
+            sweep_finished()
+            if heartbeat is not None:
+                heartbeat.beat()  # after the engine's host sync
+            reply(ok=True, delivered=list(delivered),
+                  finished=list(finished), health=engine.health())
+            # clear IN PLACE: on_token/sweep_finished close over these
+            delivered.clear()
+            finished.clear()
+        elif kind == "probe":
+            reply(finite=engine.check_params_finite())
+        elif kind == "drain":
+            engine.drain()
+            sweep_finished()
+            reply(ok=True, finished=list(finished))
+            finished.clear()
+        elif kind == "close":
+            shutdown()  # drain + close exactly once (finally is a noop)
+            sweep_finished()
+            reply(ok=True, finished=finished)
+            return 0
+        else:
+            reply(ok=False, error=f"unknown op {kind!r}")
+    # stdin EOF: the router died — the caller's finally drains and
+    # closes, so the worker never lingers as an orphan
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

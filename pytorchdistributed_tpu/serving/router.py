@@ -1,0 +1,1329 @@
+"""Replicated serving: a health-checked replica router with lossless
+mid-stream failover (ISSUE 9 — ROADMAP item 3's traffic-scale half).
+
+Everything below serving/engine.py serves from ONE engine on ONE mesh: a
+single crash, hang, or NaN'd parameter tree kills every in-flight stream
+and drops the queue. The reference tutorial's whole fault-tolerance story
+is the torchrun elastic agent — detect a dead worker, relaunch the job
+from the env-contract rendezvous (SURVEY §2b; reproduced for *training*
+in PR 4). This module is the SERVING restatement of that contract:
+
+  * a host-side ``ReplicaRouter`` owns N ``ServingEngine`` replicas —
+    in-process (the CPU test tier and single-host multi-engine) or as
+    SUBPROCESS workers launched with the same RANK/WORLD_SIZE/MASTER_*
+    env contract ``run.py`` gives training workers, SIGTERM forwarding
+    and ``kill_group`` escalation included;
+  * ``submit()`` load-balances across replicas on the telemetry the
+    engine already emits (slot occupancy, queue depth, pool pressure,
+    TTFT EMA — ``ServingEngine.health()``);
+  * every replica is health-checked per router tick: a **progress
+    watermark** (monotonic completed-compiled-call counter, the serving
+    analog of runtime/heartbeat.py's device-sync'd beats) catches hangs
+    within a bounded number of ticks, process exit / pipe EOF catches
+    crashes immediately, and a periodic compiled **params-finite probe**
+    catches a NaN'd replica (the diagnostics-tripwire analog: garbage
+    *tokens* are perfectly finite ints, the *params* are where the rot
+    is visible);
+  * the robustness core is **lossless mid-stream failover**: every
+    request the router hands out carries its prompt, sampling params,
+    seed and generated-so-far tokens, so when a replica dies its
+    in-flight requests are redispatched to a survivor, which resumes by
+    re-prefilling prompt+generated (``submit(generated=...)`` — the
+    exact preempt-requeue mechanism the paged engine already proved
+    bitwise-safe). The client-visible greedy stream is **bitwise
+    identical** to an uninterrupted single-engine run, and seeded
+    sampled streams continue their fold_in sequence exactly where the
+    dead replica left them;
+  * on top: a per-request retry budget with ``faults/retry.py`` backoff
+    between redispatches, admission-control **load shedding** (bounded
+    router queue → immediate ``finish_reason="shed"`` instead of
+    unbounded latency), replica **quarantine/rejoin** with a warmup
+    canary re-admission, and router-level graceful **drain on SIGTERM**
+    (finish resident streams, shed the queue, leave no orphan replica).
+
+Chaos is first-class: ``faults/inject.py`` grew ``replica_crash`` /
+``replica_hang`` / ``replica_nan`` serving faults (``PTD_FAULTS`` /
+``run.py --faults`` syntax, targeted by replica index and router tick);
+the router consults the process-global injector every tick and applies
+whatever fires. tests/test_router.py is the chaos suite;
+``bench.py --mode router`` stamps balanced-occupancy spread, shed rate
+under overload, and failover recovery time.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import random
+import select
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from pytorchdistributed_tpu.faults import inject as faults_inject
+from pytorchdistributed_tpu.faults.retry import RetryPolicy
+from pytorchdistributed_tpu.serving.engine import (
+    SamplingParams,
+    ServingEngine,
+)
+from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
+
+#: Replica lifecycle states. HEALTHY serves traffic; QUARANTINED is
+#: alive but sick (params non-finite) — probed every tick, rejoined
+#: after a clean streak + canary; DEAD is crashed or hung (its requests
+#: were failed over) and never returns.
+HEALTHY, QUARANTINED, DEAD = "healthy", "quarantined", "dead"
+
+#: Default redispatch backoff: immediate-ish (serving latency budgets are
+#: milliseconds, not checkpoint-restore seconds), but still exponential
+#: so a flapping replica set cannot melt the router in a redispatch storm.
+ROUTER_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.005,
+                           backoff=2.0, max_delay_s=0.25, jitter=0.25)
+
+
+class ReplicaCrashed(RuntimeError):
+    """Raised by a replica's step when the replica is gone (injected
+    crash in-process; dead pipe/process for a subprocess worker)."""
+
+
+class RouterRequest:
+    """One client-visible request: the router's durable record of
+    everything needed to REDISPATCH the stream losslessly — prompt,
+    sampling params (seed included), stop ids, budget, and the tokens
+    delivered so far. The engine-side Request handle is disposable (it
+    dies with its replica); this one is not."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 sampling: SamplingParams, stop_ids, on_token=None,
+                 deadline_s: float | None = None):
+        self.id = next(RouterRequest._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.stop_ids = stop_ids
+        self.on_token = on_token
+        self.deadline_s = deadline_s
+        self.tokens: list[int] = []          # the delivered stream
+        self.done = False
+        self.finish_reason: str | None = None
+        self.submit_time: float | None = None
+        self.first_token_time: float | None = None
+        self.finish_time: float | None = None
+        self.retries = 0                     # redispatches consumed
+        self.replicas: list[int] = []        # placement history
+        self._eligible_at = 0.0              # redispatch backoff gate
+        self._handle = None                  # engine-side request/mirror
+        self._replica: int | None = None
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + delivered continuation (int32 [len])."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_time is None or self.submit_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+class InProcessReplica:
+    """One ServingEngine behind the replica protocol — the CPU test
+    tier's replica, and the single-host multi-engine deployment shape.
+    Fault application is cooperative (an in-process replica cannot
+    os._exit the router): ``apply_fault`` flips flags the step/health
+    paths honor, which is exactly what makes the chaos suite
+    deterministic."""
+
+    #: extra wall-clock allowance before the router's tick-based hang
+    #: watchdog may fire — 0 in-process (the engine steps synchronously
+    #: inside router ticks, so a frozen watermark over hang_ticks ticks
+    #: IS a hang); subprocess replicas answer asynchronously and set
+    #: this > 0 so fast idle router spins can't out-run a healthy
+    #: worker's response latency
+    hang_grace_s = 0.0
+    #: in-process faults are applied by the ROUTER (apply_fault);
+    #: subprocess workers run the injector against their own RANK, so
+    #: the router must not consult (and consume one-shot markers of)
+    #: the same spec on their behalf
+    faults_in_worker = False
+
+    def __init__(self, index: int, factory, *, warmup_lens=None):
+        self.index = index
+        self._factory = factory
+        self.engine: ServingEngine = factory()
+        self.warmup_lens = warmup_lens
+        self.alive = True
+        self._hung = False
+        self._crash_next = False
+
+    def warmup(self, prompt_lens=None) -> None:
+        self.engine.warmup(prompt_lens=prompt_lens or self.warmup_lens)
+
+    def submit(self, rr: RouterRequest, *, generated, deadline_s,
+               on_token):
+        return self.engine.submit(
+            rr.prompt, max_new_tokens=rr.max_new_tokens,
+            sampling=rr.sampling, stop_ids=rr.stop_ids,
+            deadline_s=deadline_s, generated=generated, on_token=on_token)
+
+    def step(self) -> None:
+        if self._crash_next:
+            self.alive = False
+            raise ReplicaCrashed(
+                f"replica {self.index}: injected crash")
+        if self._hung:
+            return  # frozen: alive, silent, zero progress
+        self.engine.step()
+
+    def health(self) -> dict:
+        h = self.engine.health()
+        h["alive"] = self.alive
+        if self._hung:
+            # a wedged device makes no progress but the HOST snapshot
+            # still reads fresh — freeze the watermark, as a real hang
+            # would
+            h["progress"] = -1
+        return h
+
+    def probe(self, exclusive: bool = False) -> bool:
+        """Device-level params-finite check (the sick tripwire);
+        ``exclusive`` is the subprocess wire-scheduling hint — a
+        synchronous in-process probe has no wire to share."""
+        return self.engine.check_params_finite()
+
+    def apply_fault(self, kind: str) -> None:
+        if kind == "replica_crash":
+            self._crash_next = True
+        elif kind == "replica_hang":
+            self._hung = True
+        elif kind == "replica_nan":
+            self.poison_params()
+
+    def poison_params(self) -> None:
+        """NaN every inexact param leaf (engine.nan_params): outputs
+        rot instantly, and only the params-finite tripwire can say
+        why."""
+        from pytorchdistributed_tpu.serving.engine import nan_params
+
+        self._saved_weights = self.engine._weights
+        self.engine.set_params(nan_params(self.engine._weights))
+
+    def restore_params(self) -> None:
+        """The operator's repair step (tests: undo poison_params) —
+        rejoin still requires the router's probe streak + canary."""
+        if getattr(self, "_saved_weights", None) is not None:
+            self.engine.set_params(self._saved_weights)
+            self._saved_weights = None
+
+    def quarantine_reset(self) -> None:
+        """Entering quarantine: retire resident garbage streams (the
+        router already redispatched them) and drop every cached prefix
+        block — K/V written under NaN params must never serve a future
+        prefix hit."""
+        self.engine.drain()
+        self.engine.invalidate_prefix_cache()
+
+    def drain(self) -> list:
+        return self.engine.drain()
+
+    def close(self) -> None:
+        if self.alive and not self._hung:
+            self.engine.close()
+
+
+class SubprocessReplica:
+    """One replica as a SEPARATE PROCESS (`python -m pytorchdistributed_
+    tpu.serving.replica_worker`), spawned with the same env contract
+    run.py gives training workers — RANK (the replica index),
+    WORLD_SIZE, MASTER_ADDR/MASTER_PORT, PTD_HEARTBEAT_DIR /
+    PTD_TELEMETRY_DIR / PTD_FAULTS pass-through — and driven over a
+    line-JSON stdin/stdout protocol with AT MOST ONE op in flight.
+
+    The async single-outstanding-op design is what makes hang detection
+    honest: the router never blocks on a wedged worker — a step op's
+    response simply fails to arrive, the progress watermark stalls, and
+    the watchdog fires after ``hang_ticks`` router ticks, exactly like
+    the in-process path. Death is immediate: process exit or pipe EOF
+    raises ReplicaCrashed at the next interaction. Teardown forwards
+    SIGTERM and escalates through run.py's ``kill_group`` — a drained
+    router can never leave an orphan worker."""
+
+    faults_in_worker = True
+
+    def __init__(self, index: int, spec: dict, *, world_size: int = 1,
+                 env: dict | None = None, hang_grace_s: float = 10.0,
+                 heartbeat_dir: str | None = None,
+                 master_port: int | None = None):
+        from pytorchdistributed_tpu.run import free_port
+
+        self.index = index
+        self.hang_grace_s = hang_grace_s
+        self._mirrors: dict[int, object] = {}
+        self._on_token: dict[int, object] = {}
+        # the run.py liveness contract: the worker touches
+        # rank<index> after every step's host sync; health() surfaces
+        # the age next to the protocol-level progress watermark
+        self.heartbeat_path = (
+            os.path.join(heartbeat_dir, f"rank{index}")
+            if heartbeat_dir else None)
+        self.alive = True
+        self._health: dict = {"alive": True, "progress": -1, "active": 0,
+                              "queued": 0, "free_slots": 0,
+                              "prefilling": 0, "num_slots": 1,
+                              "occupancy": 0.0, "pool_free_frac": 1.0,
+                              "ttft_ema_s": None, "sick": False}
+        self._pending_op: str | None = None
+        self._probe_result: bool | None = None
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        full_env.update({
+            "RANK": str(index), "LOCAL_RANK": str(index),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": "localhost",
+            # ONE port shared by the whole worker fleet (the run.py
+            # group contract): a future cross-replica rendezvous must
+            # find every rank agreeing on it
+            "MASTER_PORT": str(master_port if master_port is not None
+                               else free_port()),
+            "PTD_REPLICA_SPEC": json.dumps(spec),
+        })
+        if heartbeat_dir:
+            from pytorchdistributed_tpu.runtime.heartbeat import (
+                HEARTBEAT_DIR_ENV,
+            )
+
+            os.makedirs(heartbeat_dir, exist_ok=True)
+            full_env[HEARTBEAT_DIR_ENV] = heartbeat_dir
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "pytorchdistributed_tpu.serving.replica_worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            env=full_env, text=True, bufsize=1)
+
+    # -- wire ---------------------------------------------------------
+
+    def _send(self, op: dict) -> None:
+        if not self.alive or self.proc.poll() is not None:
+            self.alive = False
+            raise ReplicaCrashed(f"replica {self.index}: worker exited "
+                                 f"(code {self.proc.poll()})")
+        try:
+            self.proc.stdin.write(json.dumps(op) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            self.alive = False
+            raise ReplicaCrashed(
+                f"replica {self.index}: pipe broke ({e})") from None
+        self._pending_op = op["op"]
+        self._last_sent = op["op"]
+
+    def _try_recv(self, timeout: float = 0.0) -> dict | None:
+        """Non-blocking (or bounded) read of the pending response; None
+        when the worker hasn't answered yet — the router moves on and
+        the watermark records the silence."""
+        if self._pending_op is None:
+            return None
+        r, _, _ = select.select([self.proc.stdout], [], [], timeout)
+        if not r:
+            if self.proc.poll() is not None:
+                self.alive = False
+                raise ReplicaCrashed(
+                    f"replica {self.index}: worker exited "
+                    f"(code {self.proc.poll()})")
+            return None
+        line = self.proc.stdout.readline()
+        if not line:
+            self.alive = False
+            raise ReplicaCrashed(f"replica {self.index}: EOF "
+                                 f"(code {self.proc.poll()})")
+        self._pending_op = None
+        return json.loads(line)
+
+    def wait_response(self, timeout: float) -> dict:
+        """Blocking receive for the synchronous phases (warmup, close)
+        where the caller legitimately waits — never used in the
+        steady-state tick loop."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            resp = self._try_recv(timeout=0.2)
+            if resp is not None:
+                return resp
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"replica {self.index}: no response within "
+                    f"{timeout}s (op {self._pending_op})")
+
+    # -- replica protocol ---------------------------------------------
+
+    def warmup(self, prompt_lens=None) -> None:
+        self._send({"op": "warmup",
+                    "prompt_lens": list(prompt_lens or [])})
+        # first warmup pays the worker's jax import + compiles; the
+        # reply carries the engine's real max_seq_len
+        self._consume(self.wait_response(timeout=600.0))
+
+    def submit(self, rr: RouterRequest, *, generated, deadline_s,
+               on_token):
+        self._drain_wire()
+        self._send({"op": "submit", "rid": rr.id,
+                    "prompt": rr.prompt.tolist(),
+                    "max_new_tokens": rr.max_new_tokens,
+                    "sampling": {
+                        "temperature": rr.sampling.temperature,
+                        "top_k": rr.sampling.top_k,
+                        "top_p": rr.sampling.top_p,
+                        "seed": rr.sampling.seed},
+                    "stop_ids": list(rr.stop_ids),
+                    "generated": list(generated or []),
+                    "deadline_s": deadline_s})
+        self._on_token[rr.id] = on_token
+
+        class _Mirror:
+            done = False
+            finish_reason = None
+        m = _Mirror()
+        self._mirrors[rr.id] = m
+        return m
+
+    def _drain_wire(self, timeout: float | None = None) -> None:
+        """Consume the pending response (if any) before sending a new
+        op — the one-in-flight invariant. Only submit/drain/close use
+        it; the steady-state step path is fully non-blocking. The
+        default bound is ``hang_grace_s``: a healthy worker answers in
+        milliseconds, and a wedged one must not stall the whole router
+        longer than the hang watchdog would have tolerated anyway (the
+        TimeoutError surfaces as a dead-replica declaration)."""
+        if self._pending_op is not None:
+            resp = self.wait_response(
+                self.hang_grace_s if timeout is None else timeout)
+            self._consume(resp)
+
+    def _consume(self, resp: dict) -> None:
+        if resp.get("ok") is False and "rid" in resp:
+            # the worker REFUSED the submit (validation error): the
+            # request is terminal — redispatching it would only collect
+            # the same refusal fleet-wide
+            m = self._mirrors.pop(resp["rid"], None)
+            if m is not None:
+                m.done, m.finish_reason = True, "failed"
+            self._on_token.pop(resp["rid"], None)
+            return
+        if "max_seq_len" in resp:
+            self.reported_max_seq_len = int(resp["max_seq_len"])
+        if resp.get("health"):
+            self._health = resp["health"]
+            self._health["alive"] = True
+        for rid, tok in resp.get("delivered", []):
+            cb = self._on_token.get(rid)
+            if cb is not None:
+                cb(rid, tok)
+        for rid, reason in resp.get("finished", []):
+            m = self._mirrors.pop(rid, None)
+            if m is not None:
+                m.done, m.finish_reason = True, reason
+            # drop the per-request closure too, or a long-lived worker
+            # retains every RouterRequest it ever served
+            self._on_token.pop(rid, None)
+        if "finite" in resp:
+            self._probe_result = bool(resp["finite"])
+
+    def step(self) -> None:
+        """One async protocol turn: collect whatever the worker answered
+        since last tick, then (if the wire is idle) send the next step
+        op. No response → no progress recorded → the hang watchdog's
+        evidence accumulates."""
+        resp = self._try_recv()
+        if resp is not None:
+            self._consume(resp)
+        if self._pending_op is None:
+            self._send({"op": "step"})
+
+    def health(self) -> dict:
+        h = dict(self._health)
+        h["alive"] = self.alive
+        if self.heartbeat_path is not None:
+            from pytorchdistributed_tpu.runtime.heartbeat import (
+                last_beat_age,
+            )
+
+            h["heartbeat_age_s"] = last_beat_age(self.heartbeat_path)
+        return h
+
+    def probe(self, exclusive: bool = False) -> bool:
+        """Params-finite probe over the wire. Answered asynchronously:
+        returns the LAST verdict (optimistically True before the first
+        answer arrives) and keeps the pipeline moving. RECEIVE before
+        deciding to send: the steady-state loop always leaves a step op
+        pending, so a send-first probe would be skipped every single
+        time and a NaN'd worker would never be caught. Never send two
+        probes back to back: at health_every=1 that would monopolize
+        the one-in-flight wire and STARVE the step ops — probe and step
+        alternate instead. ``exclusive=True`` (a QUARANTINED replica,
+        which is never stepped, so probes are the only traffic) lifts
+        the alternation."""
+        resp = self._try_recv()
+        if resp is not None:
+            self._consume(resp)
+        if (self._pending_op is None
+                and (exclusive
+                     or getattr(self, "_last_sent", None) != "probe")):
+            self._send({"op": "probe"})
+        return self._probe_result if self._probe_result is not None else True
+
+    def apply_fault(self, kind: str) -> None:
+        """Subprocess faults ride PTD_FAULTS into the worker itself
+        (it runs the injector against its own RANK) — the router-side
+        application is a no-op here."""
+
+    def quarantine_reset(self) -> None:
+        try:
+            self._drain_wire()
+            self._send({"op": "drain"})
+            self._consume(self.wait_response(60.0))
+        except (ReplicaCrashed, TimeoutError):
+            self.alive = False
+
+    def drain(self) -> list:
+        self.quarantine_reset()
+        return []
+
+    def close(self, grace: float = 10.0) -> None:
+        """Graceful protocol close, then the run.py teardown escalation
+        (SIGTERM → SIGCONT → SIGKILL after grace) — no orphans, even if
+        the worker is wedged or SIGSTOPped."""
+        from pytorchdistributed_tpu.run import kill_group
+
+        if self.alive and self.proc.poll() is None:
+            try:
+                self._drain_wire(timeout=5.0)
+                self._send({"op": "close"})
+            except (ReplicaCrashed, TimeoutError):
+                pass
+        kill_group([self.proc], grace=grace)
+        self.alive = False
+        for pipe in (self.proc.stdin, self.proc.stdout):
+            try:
+                pipe.close()
+            except OSError:
+                pass
+
+
+class ReplicaRouter:
+    """The health-checked, failover-capable front of N serving replicas.
+
+    Construction (pick one):
+      * ``ReplicaRouter(model, params, replicas=N, engine_kwargs={...})``
+        — N in-process ServingEngines over shared weights (they also
+        share the jit cache: N replicas compile once);
+      * ``ReplicaRouter(factories=[...])`` — explicit per-replica
+        engine factories (different pool sizes, meshes, ...);
+      * ``ReplicaRouter(workers=[spec, ...])`` — subprocess replicas:
+        each spec is a replica_worker model/engine description, each
+        worker is launched under the run.py env contract.
+
+    Knobs:
+      max_queue: router admission bound — a submit arriving with this
+        many requests already queued is SHED immediately
+        (``finish_reason="shed"``): bounded latency for everyone
+        admitted beats unbounded latency for everyone.
+      max_retries: redispatches a single request may consume before it
+        is failed (``finish_reason="failed"``) — the retry budget.
+      retry_policy: faults/retry.py backoff between a request's
+        redispatches (default ROUTER_RETRY: ms-scale, exponential,
+        jittered).
+      hang_ticks: consecutive router ticks a replica may hold work
+        without moving its progress watermark before it is declared
+        hung — the watchdog bound (detection latency ≤ hang_ticks
+        ticks, asserted in the chaos suite).
+      health_every: params-finite probe cadence in ticks (the probe is
+        one compiled scalar reduction; every tick would double the
+        tick's device dispatches for tiny models).
+      rejoin_after: consecutive CLEAN probes a quarantined replica
+        needs before the warmup canary + re-admission.
+      faults: a FaultInjector, None to disable chaos entirely, or
+        "auto" (default: the process-global ``faults.active()`` —
+        the PTD_FAULTS contract).
+      telemetry / telemetry_dir: RouterTelemetry sink (per-replica
+        rows + event rows + close-time summary).
+      seed: the jitter RNG for redispatch backoff (deterministic
+        schedules for the chaos suite).
+    """
+
+    def __init__(self, model=None, params=None, *, replicas: int = 2,
+                 engine_kwargs: dict | None = None, factories=None,
+                 workers=None, warmup_lens=None,
+                 max_queue: int | None = None, max_retries: int = 2,
+                 retry_policy: RetryPolicy = ROUTER_RETRY,
+                 hang_ticks: int = 8, health_every: int = 4,
+                 rejoin_after: int = 3, max_pending: int = 1,
+                 faults="auto", telemetry: RouterTelemetry | None = None,
+                 telemetry_dir=None, sample_every: int = 1,
+                 seed: int = 0):
+        self.warmup_lens = tuple(warmup_lens) if warmup_lens else None
+        self._hb_dir = None
+        if workers is not None:
+            import tempfile
+
+            from pytorchdistributed_tpu.run import free_port
+
+            # one liveness dir + ONE master port for the worker fleet
+            # (the run.py group env contract); dir removed at close()
+            self._hb_dir = tempfile.mkdtemp(prefix="ptd_router_hb_")
+            port = free_port()
+            self._replicas = [
+                SubprocessReplica(i, spec, world_size=len(workers),
+                                  heartbeat_dir=self._hb_dir,
+                                  master_port=port)
+                for i, spec in enumerate(workers)]
+            self.max_seq_len = min(
+                int(s.get("max_seq_len",
+                          s.get("overrides", {}).get("max_seq_len",
+                                                     1 << 30)))
+                for s in workers)
+        else:
+            if factories is None:
+                if model is None or params is None:
+                    raise ValueError(
+                        "pass (model, params), factories=, or workers=")
+                kw = dict(engine_kwargs or {})
+                # with a telemetry_dir, each engine gets its own
+                # ServingTelemetry at rank=replica-index, so the
+                # serve_metrics/span files land per replica (the report
+                # CLI's serving table then reads as a per-replica
+                # table) instead of being silently dropped
+                wire_tele = (telemetry_dir is not None
+                             and "telemetry" not in kw
+                             and "telemetry_dir" not in kw)
+
+                def make_factory(i):
+                    def factory():
+                        ekw = dict(kw)
+                        if wire_tele:
+                            from pytorchdistributed_tpu.serving.telemetry \
+                                import ServingTelemetry
+
+                            ekw["telemetry"] = ServingTelemetry(
+                                telemetry_dir, rank=i)
+                        return ServingEngine(model, params, **ekw)
+                    return factory
+
+                factories = [make_factory(i) for i in range(replicas)]
+            self._replicas = [
+                InProcessReplica(i, f, warmup_lens=self.warmup_lens)
+                for i, f in enumerate(factories)]
+            self.max_seq_len = min(
+                r.engine.cfg.max_seq_len for r in self._replicas)
+        if not self._replicas:
+            raise ValueError("need at least one replica")
+        self.max_queue = max_queue
+        self.max_retries = max_retries
+        self.retry_policy = retry_policy
+        self.hang_ticks = max(1, hang_ticks)
+        self.health_every = max(1, health_every)
+        self.rejoin_after = max(1, rejoin_after)
+        self.max_pending = max(0, max_pending)
+        # "auto" = the process-global PTD_FAULTS contract; None = chaos
+        # explicitly off (bench baseline legs); or a FaultInjector
+        self._faults = (faults_inject.active() if faults == "auto"
+                        else faults)
+        self._rng = random.Random(seed)
+        if telemetry is None and telemetry_dir is not None:
+            telemetry = RouterTelemetry(telemetry_dir)
+        self.telemetry = telemetry
+        self.sample_every = max(1, sample_every)
+        self._queue: collections.deque[RouterRequest] = collections.deque()
+        self._assigned: list[dict[int, RouterRequest]] = [
+            {} for _ in self._replicas]
+        self._status = [HEALTHY for _ in self._replicas]
+        self._last_progress = [None for _ in self._replicas]
+        self._last_progress_t = [time.perf_counter()
+                                 for _ in self._replicas]
+        self._stale = [0 for _ in self._replicas]
+        self._clean_probes = [0 for _ in self._replicas]
+        self._health: list[dict] = [r.health() for r in self._replicas]
+        self._placements = [0 for _ in self._replicas]
+        self._ticks = 0
+        self._draining = False
+        self._recovering: list[dict] = []
+        self._occ_sum = [0.0 for _ in self._replicas]
+        self._occ_n = [0 for _ in self._replicas]
+        self.reset_stats()
+
+    # ------------------------------------------------------------------
+    # submission + shedding
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               sampling: SamplingParams | None = None, stop_ids=None,
+               on_token=None,
+               deadline_s: float | None = None) -> RouterRequest:
+        """Queue one request with the router (dispatch to a replica
+        happens inside step(), against fresh health snapshots). Returns
+        the durable RouterRequest handle — ``handle.tokens`` is the
+        client stream and survives any number of failovers.
+
+        Admission control: when the router queue already holds
+        ``max_queue`` requests, the request is REJECTED here —
+        ``done=True, finish_reason="shed"``, zero tokens — instead of
+        joining an unbounded line. Shedding at submit is the load-
+        shedding contract: overload costs the shed request one cheap
+        refusal, not every admitted request its latency SLO."""
+        from pytorchdistributed_tpu.inference import stop_ids_tuple
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt_len {prompt.size} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        rr = RouterRequest(prompt, max_new_tokens,
+                           sampling or SamplingParams(),
+                           stop_ids_tuple(stop_ids), on_token,
+                           deadline_s=deadline_s)
+        rr.submit_time = time.perf_counter()
+        self._stats["submitted"] += 1
+        if self._draining:
+            self._finish(rr, "drained")
+            return rr
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            # last look before refusing: place whatever the replicas
+            # can already hold, so the bound sheds on CAPACITY, not on
+            # how recently the caller interleaved a step()
+            self._dispatch()
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            self._stats["shed_requests"] += 1
+            self._event("shed", request=rr.id,
+                        queued=len(self._queue))
+            self._finish(rr, "shed")
+            return rr
+        self._queue.append(rr)
+        return rr
+
+    # ------------------------------------------------------------------
+    # the router loop
+
+    def step(self) -> dict:
+        """One router iteration:
+
+          1. consult the fault injector (chaos schedule) per replica;
+          2. refresh health snapshots; run the hang watchdog and the
+             sick-probe/quarantine/rejoin state machine;
+          3. dispatch queued requests to the least-loaded replicas
+             with room;
+          4. step every healthy replica one engine step (a crash here
+             is caught and becomes a failover);
+          5. reap finished requests and expired router-queue deadlines.
+        """
+        if self._draining:
+            self.drain()
+            return self._step_stats(0)
+        self._ticks += 1
+        # 1. chaos schedule (in-process replicas only: subprocess
+        # workers fire the injector against their own RANK — consulting
+        # it here too would consume the one-shot marker and log an
+        # injection that never happened)
+        if self._faults is not None:
+            for r in self._replicas:
+                if (self._status[r.index] != DEAD
+                        and not getattr(r, "faults_in_worker", False)):
+                    kind = self._faults.on_serving_tick(self._ticks,
+                                                        r.index)
+                    if kind:
+                        r.apply_fault(kind)
+        # 2. health + watchdog + quarantine machine
+        self._check_health()
+        # 3. dispatch
+        dispatched = self._dispatch()
+        # 4. step replicas
+        for r in self._replicas:
+            if self._status[r.index] != HEALTHY:
+                continue
+            try:
+                r.step()
+            except ReplicaCrashed:
+                self._declare_dead(r, "crashed")
+        # 5. reap
+        self._reap()
+        self._expire_queued_deadlines()
+        if (self.telemetry is not None
+                and self._ticks % self.sample_every == 0):
+            for r in self._replicas:
+                h = self._health[r.index]
+                self.telemetry.replica(
+                    tick=self._ticks, replica=r.index,
+                    status=self._status[r.index],
+                    active=h.get("active", 0), queued=h.get("queued", 0),
+                    occupancy=round(h.get("occupancy", 0.0), 4),
+                    progress=h.get("progress", -1))
+        return self._step_stats(dispatched)
+
+    def _step_stats(self, dispatched: int) -> dict:
+        return {"tick": self._ticks, "dispatched": dispatched,
+                "queued": len(self._queue),
+                "in_flight": sum(len(a) for a in self._assigned),
+                "healthy": sum(s == HEALTHY for s in self._status)}
+
+    # -- health machine ------------------------------------------------
+
+    def _check_health(self) -> None:
+        for r in self._replicas:
+            i = r.index
+            if self._status[i] == DEAD:
+                continue
+            try:
+                h = r.health()
+            except ReplicaCrashed:
+                self._declare_dead(r, "crashed")
+                continue
+            self._health[i] = h
+            if not h.get("alive", True):
+                self._declare_dead(r, "crashed")
+                continue
+            if self._status[i] == HEALTHY:
+                self._occ_sum[i] += h.get("occupancy", 0.0)
+                self._occ_n[i] += 1
+                # hang watchdog: work assigned + watermark frozen for
+                # hang_ticks ticks AND (async replicas) longer than the
+                # replica's wall-clock grace — a fast-spinning idle
+                # router must not out-run a healthy subprocess worker's
+                # response latency
+                now = time.perf_counter()
+                prog = h.get("progress", -1)
+                if self._assigned[i] and prog == self._last_progress[i]:
+                    self._stale[i] += 1
+                else:
+                    self._stale[i] = 0
+                    self._last_progress_t[i] = now
+                self._last_progress[i] = prog
+                if (self._stale[i] >= self.hang_ticks
+                        and now - self._last_progress_t[i]
+                        >= getattr(r, "hang_grace_s", 0.0)):
+                    self._declare_dead(r, "hung")
+                    continue
+                # periodic sick probe
+                if self._ticks % self.health_every == 0:
+                    try:
+                        ok = r.probe()
+                    except ReplicaCrashed:
+                        self._declare_dead(r, "crashed")
+                        continue
+                    if not ok:
+                        self._quarantine(r)
+            elif self._status[i] == QUARANTINED:
+                try:
+                    ok = r.probe(exclusive=True)
+                except ReplicaCrashed:
+                    self._declare_dead(r, "crashed")
+                    continue
+                self._clean_probes[i] = self._clean_probes[i] + 1 if ok \
+                    else 0
+                if self._clean_probes[i] >= self.rejoin_after:
+                    self._rejoin(r)
+
+    def _declare_dead(self, r, why: str) -> None:
+        if self._status[r.index] == DEAD:
+            return
+        self._status[r.index] = DEAD
+        self._stats["replicas_lost"] += 1
+        if why == "hung":
+            self._stats["hangs_detected"] += 1
+        self._event("replica_dead", replica=r.index, why=why,
+                    stale_ticks=self._stale[r.index])
+        self._failover(r, why)
+
+    def _quarantine(self, r) -> None:
+        """Sick (params non-finite): fail its streams over NOW — every
+        token it would emit is garbage — then park it out of rotation,
+        probing for recovery."""
+        self._status[r.index] = QUARANTINED
+        self._clean_probes[r.index] = 0
+        self._stats["quarantines"] += 1
+        self._event("quarantine", replica=r.index)
+        self._failover(r, "sick")
+        try:
+            r.quarantine_reset()
+        except ReplicaCrashed:
+            self._declare_dead(r, "crashed")
+
+    def _rejoin(self, r) -> None:
+        """Probe streak clean → warmup re-admission: run one canary
+        request end-to-end on the replica (re-exercising prefill +
+        tick on the repaired weights) before real traffic returns.
+        In-process the canary is synchronous and cheap (the programs
+        are already compiled — a rejoin costs zero recompiles)."""
+        if isinstance(r, InProcessReplica):
+            try:
+                n = min(self.warmup_lens[0] if self.warmup_lens else 8,
+                        self.max_seq_len - 2)
+                canary = r.engine.submit(np.zeros(n, np.int32),
+                                         max_new_tokens=2)
+                r.engine.run_until_idle()
+                if not canary.done or not r.probe():
+                    self._clean_probes[r.index] = 0
+                    return  # not actually ready — keep quarantined
+            except ReplicaCrashed:
+                self._declare_dead(r, "crashed")
+                return
+        self._status[r.index] = HEALTHY
+        self._stale[r.index] = 0
+        self._last_progress[r.index] = None
+        self._last_progress_t[r.index] = time.perf_counter()
+        self._stats["rejoins"] += 1
+        self._event("rejoin", replica=r.index)
+
+    # -- failover ------------------------------------------------------
+
+    def _failover(self, r, why: str) -> None:
+        """Redispatch every in-flight request of a lost replica. The
+        RouterRequest carries prompt + sampling + seed + delivered
+        tokens, so survivors resume the stream losslessly
+        (submit(generated=...)); a retry budget caps how many deaths a
+        single request may surf, and the backoff gate keeps a flapping
+        fleet from a redispatch storm."""
+        victims = list(self._assigned[r.index].values())
+        self._assigned[r.index].clear()
+        if not victims:
+            self._stats["failovers"] += 1
+            return
+        now = time.perf_counter()
+        self._stats["failovers"] += 1
+        pending = set()
+        for rr in reversed(victims):  # appendleft keeps arrival order
+            if rr._handle is not None and getattr(rr._handle, "done",
+                                                  False):
+                # finished on the replica in its final moments, not yet
+                # reaped — deliverable as-is, no redispatch needed
+                self._finish(rr, rr._handle.finish_reason)
+                continue
+            rr._handle = None
+            rr._replica = None
+            rr.retries += 1
+            if rr.retries > self.max_retries:
+                self._event("retries_exhausted", request=rr.id,
+                            retries=rr.retries)
+                self._finish(rr, "failed")
+                continue
+            delay = self.retry_policy.delay(rr.retries, self._rng)
+            rr._eligible_at = now + delay
+            self._queue.appendleft(rr)
+            pending.add(rr.id)
+            self._stats["redispatched_requests"] += 1
+            self._event("redispatch", request=rr.id, from_replica=r.index,
+                        why=why, retries=rr.retries,
+                        delay_ms=round(delay * 1e3, 3),
+                        tokens_so_far=len(rr.tokens))
+        if pending:
+            self._recovering.append(
+                {"start": self._ticks, "start_t": now, "pending": pending})
+
+    # -- dispatch ------------------------------------------------------
+
+    def _replica_score(self, h: dict, mean_ttft: float | None) -> float:
+        """Lower = less loaded. Occupancy and queue depth dominate;
+        pool pressure breaks slot ties (a paged replica about to
+        preempt is a worse home than one with headroom); the TTFT EMA
+        nudges traffic away from a replica whose admissions have been
+        slow (relative to the fleet, so the signal is scale-free)."""
+        ns = max(1, h.get("num_slots", 1))
+        score = (h.get("occupancy", 0.0)
+                 + (h.get("queued", 0) + h.get("prefilling", 0)) / ns
+                 + 0.5 * (1.0 - h.get("pool_free_frac", 1.0)))
+        ema = h.get("ttft_ema_s")
+        if ema is not None and mean_ttft:
+            score += 0.25 * min(ema / mean_ttft, 2.0)
+        return score
+
+    def _dispatch(self) -> int:
+        healthy = [r for r in self._replicas
+                   if self._status[r.index] == HEALTHY]
+        if not healthy or not self._queue:
+            return 0
+        emas = [self._health[r.index].get("ttft_ema_s") for r in healthy]
+        emas = [e for e in emas if e]
+        mean_ttft = sum(emas) / len(emas) if emas else None
+        now = time.perf_counter()
+        dispatched = 0
+        deferred: list[RouterRequest] = []
+        while self._queue:
+            rr = self._queue.popleft()
+            if rr.done:
+                continue
+            if rr._eligible_at > now:   # redispatch backoff
+                deferred.append(rr)
+                continue
+            if rr.deadline_s is not None:
+                remaining = rr.deadline_s - (now - rr.submit_time)
+                if remaining <= 0:
+                    self._finish(rr, "deadline")
+                    continue
+            # room = the replica can hold it without unbounded queueing;
+            # ties break toward the replica with fewer lifetime
+            # placements (deterministic round-robin under light load —
+            # a pure index tie-break would starve the higher indices)
+            best, best_key = None, None
+            for r in healthy:
+                h = self._health[r.index]
+                load = (h.get("active", 0) + h.get("queued", 0)
+                        + h.get("prefilling", 0))
+                if load >= h.get("num_slots", 1) + self.max_pending:
+                    continue
+                key = (self._replica_score(h, mean_ttft),
+                       self._placements[r.index], r.index)
+                if best_key is None or key < best_key:
+                    best, best_key = r, key
+            if best is None:
+                deferred.append(rr)   # every replica full: wait
+                break
+            if not self._place(rr, best):
+                # the pick died at placement (request was requeued);
+                # stop this pass — the next tick re-dispatches against
+                # refreshed health, never against this stale snapshot
+                break
+            dispatched += 1
+        # untouched tail keeps FIFO order behind the deferred heads
+        for rr in reversed(deferred):
+            self._queue.appendleft(rr)
+        return dispatched
+
+    def _place(self, rr: RouterRequest, r) -> bool:
+        remaining = None
+        if rr.deadline_s is not None:
+            remaining = max(
+                0.001,
+                rr.deadline_s - (time.perf_counter() - rr.submit_time))
+
+        # first arg is the engine Request (in-process) or the rid
+        # (subprocess) — either way the RouterRequest closure is the
+        # identity that matters
+        def cb(_handle, tok, rr=rr, idx=r.index):
+            self._on_token(rr, idx, tok)
+
+        try:
+            handle = r.submit(rr, generated=rr.tokens or None,
+                              deadline_s=remaining, on_token=cb)
+        except (ReplicaCrashed, TimeoutError):
+            # the pick died (or stopped answering) between health check
+            # and placement: requeue the request, let the health
+            # machinery take the replica down
+            self._queue.appendleft(rr)
+            self._declare_dead(r, "crashed")
+            return False
+        rr._handle = handle
+        rr._replica = r.index
+        rr.replicas.append(r.index)
+        self._placements[r.index] += 1
+        self._assigned[r.index][rr.id] = rr
+        # keep this tick's snapshot honest for the next pick
+        self._health[r.index]["queued"] = \
+            self._health[r.index].get("queued", 0) + 1
+        return True
+
+    def _on_token(self, rr: RouterRequest, replica: int, tok: int) -> None:
+        if rr.done or rr._replica != replica:
+            return  # stale delivery from a replaced placement
+        rr.tokens.append(int(tok))
+        if rr.first_token_time is None:
+            rr.first_token_time = time.perf_counter()
+        if rr.on_token is not None:
+            rr.on_token(rr, int(tok))
+        for rec in self._recovering:
+            rec["pending"].discard(rr.id)
+        self._gc_recovering()
+
+    def _gc_recovering(self) -> None:
+        done = [rec for rec in self._recovering if not rec["pending"]]
+        for rec in done:
+            self._recovering.remove(rec)
+            self._stats["failover_recovery_ticks"].append(
+                self._ticks - rec["start"])
+            self._stats["failover_recovery_s"].append(
+                round(time.perf_counter() - rec["start_t"], 4))
+
+    def _reap(self) -> None:
+        for r in self._replicas:
+            assigned = self._assigned[r.index]
+            for rid in [rid for rid, rr in assigned.items()
+                        if rr._handle is not None and rr._handle.done]:
+                rr = assigned.pop(rid)
+                self._finish(rr, rr._handle.finish_reason)
+
+    def _expire_queued_deadlines(self) -> None:
+        now = time.perf_counter()
+        overdue = [rr for rr in self._queue
+                   if rr.deadline_s is not None
+                   and now - rr.submit_time >= rr.deadline_s]
+        for rr in overdue:
+            self._queue.remove(rr)
+            self._finish(rr, "deadline")
+
+    def _finish(self, rr: RouterRequest, reason: str | None) -> None:
+        if rr.done:
+            return
+        rr.done = True
+        rr.finish_reason = reason or "unknown"
+        rr.finish_time = time.perf_counter()
+        rr._handle = None
+        # "completed" counts streams that reached a SERVING conclusion
+        # — shed/drained/failed refusals have their own counters and
+        # must not inflate it (or the report would read 24/24 served
+        # on a trace that shed 10)
+        if reason in ("length", "stop", "deadline"):
+            self._stats["completed"] += 1
+            if rr._replica is not None:
+                self._stats["served_by"][rr._replica] = \
+                    self._stats["served_by"].get(rr._replica, 0) + 1
+        if reason == "failed":
+            self._stats["failed_requests"] += 1
+        if rr.ttft_s is not None:
+            self._stats["ttft_s"].append(rr.ttft_s)
+        for rec in self._recovering:
+            rec["pending"].discard(rr.id)
+        self._gc_recovering()
+
+    def _event(self, event: str, **row) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(event, tick=self._ticks, **row)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def warmup(self, prompt_lens=None) -> None:
+        """Warm every replica (each engine compiles its tick + prefill
+        buckets — in-process replicas over the same model share the jit
+        cache, so N replicas compile once) and reset router stats.
+        Resume-from-tokens redispatch reuses the SAME compiled prefill
+        programs, so warming the buckets here is what makes a failover
+        recompile-free on the survivors."""
+        lens = prompt_lens or self.warmup_lens
+        for r in self._replicas:
+            r.warmup(lens)
+        # subprocess workers report their engines' true context bound
+        # at warmup — tighten submit validation to the real minimum
+        reported = [getattr(r, "reported_max_seq_len", None)
+                    for r in self._replicas]
+        reported = [v for v in reported if v]
+        if reported:
+            self.max_seq_len = min([self.max_seq_len] + reported)
+        self.reset_stats()
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        while self._queue or any(self._assigned[r.index]
+                                 for r in self._replicas):
+            # quarantined replicas still count: the rejoin probes that
+            # could restore them only run inside step() — only an
+            # all-DEAD fleet is genuinely unrecoverable
+            if all(s == DEAD for s in self._status):
+                raise RuntimeError(
+                    "every replica is dead with work outstanding")
+            if max_steps <= 0:
+                raise RuntimeError("router loop did not drain")
+            self.step()
+            max_steps -= 1
+
+    def stream(self, rr: RouterRequest):
+        """Iterator over one request's tokens, stepping the router —
+        failover happens transparently underneath; the stream just
+        keeps going."""
+        sent = 0
+        while True:
+            while sent < len(rr.tokens):
+                yield rr.tokens[sent]
+                sent += 1
+            if rr.done:
+                return
+            if all(s == DEAD for s in self._status):
+                raise RuntimeError(
+                    "every replica is dead; the stream cannot finish")
+            self.step()
+
+    def request_drain(self) -> None:
+        """Signal-handler-safe drain request (the run.py SIGTERM
+        forwarding contract) — the next step() performs the actual
+        drain outside the signal frame."""
+        self._draining = True
+
+    def install_sigterm_drain(self) -> None:
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: self.request_drain())
+
+    def drain(self, max_steps: int = 100_000) -> list[RouterRequest]:
+        """Graceful drain: queued requests are shed with
+        ``finish_reason="drained"`` (they never started streaming —
+        refusing them cleanly beats a half-stream), RESIDENT streams
+        run to completion on their replicas, then nothing new is
+        admitted. Returns the requests finished by the drain."""
+        self._draining = True
+        out: list[RouterRequest] = []
+        while self._queue:
+            rr = self._queue.popleft()
+            self._finish(rr, "drained")
+            out.append(rr)
+        while any(self._assigned[r.index] for r in self._replicas
+                  if self._status[r.index] == HEALTHY) and max_steps:
+            for r in self._replicas:
+                if self._status[r.index] != HEALTHY:
+                    continue
+                try:
+                    r.step()
+                except ReplicaCrashed:
+                    self._declare_dead(r, "crashed")
+            self._reap()
+            max_steps -= 1
+        # streams stranded on dead replicas at drain time, plus any a
+        # mid-drain crash FAILED OVER back onto the queue (nothing
+        # dispatches during a drain): finished with what they have —
+        # the drain contract is bounded shutdown, not infinite
+        # redispatch
+        for r in self._replicas:
+            for rr in list(self._assigned[r.index].values()):
+                self._finish(rr, "drained")
+                out.append(rr)
+            self._assigned[r.index].clear()
+        while self._queue:
+            rr = self._queue.popleft()
+            self._finish(rr, "drained")
+            out.append(rr)
+        self._event("drained", finished=len(out))
+        return out
+
+    def close(self) -> None:
+        """Drain, close every replica (engines assert their pool-leak
+        invariant; subprocess workers get the SIGTERM→kill_group
+        escalation — no orphans), stamp the telemetry summary."""
+        self.drain()
+        subs = [r for r in self._replicas
+                if isinstance(r, SubprocessReplica)]
+        for r in self._replicas:
+            if r in subs:
+                continue
+            try:
+                r.close()
+            except ReplicaCrashed:
+                pass
+        if subs:
+            # group teardown: best-effort protocol close to each, then
+            # ONE kill_group escalation over the whole fleet — N wedged
+            # workers cost one grace window, not N
+            from pytorchdistributed_tpu.run import kill_group
+
+            for r in subs:
+                if r.alive and r.proc.poll() is None:
+                    try:
+                        r._drain_wire(timeout=2.0)
+                        r._send({"op": "close"})
+                    except (ReplicaCrashed, TimeoutError):
+                        pass
+            kill_group([r.proc for r in subs], grace=10.0)
+            for r in subs:
+                r.alive = False
+                for pipe in (r.proc.stdin, r.proc.stdout):
+                    try:
+                        pipe.close()
+                    except OSError:
+                        pass
+        if self._hb_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._hb_dir, ignore_errors=True)
+            self._hb_dir = None
+        if self.telemetry is not None:
+            self.telemetry.summary(**self.summary())
+            self.telemetry.close()
+
+    # ------------------------------------------------------------------
+    # stats
+
+    def reset_stats(self) -> None:
+        self._stats = dict(submitted=0, completed=0, shed_requests=0,
+                           failed_requests=0, failovers=0,
+                           redispatched_requests=0, quarantines=0,
+                           rejoins=0, hangs_detected=0, replicas_lost=0,
+                           served_by={}, ttft_s=[],
+                           failover_recovery_ticks=[],
+                           failover_recovery_s=[])
+        self._occ_sum = [0.0 for _ in self._replicas]
+        self._occ_n = [0 for _ in self._replicas]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(len(a) for a in self._assigned)
+
+    def health(self) -> list[dict]:
+        """The latest per-replica snapshots, status included."""
+        out = []
+        for r in self._replicas:
+            h = dict(self._health[r.index])
+            h["replica"] = r.index
+            h["status"] = self._status[r.index]
+            out.append(h)
+        return out
+
+    def summary(self) -> dict:
+        """Router-level aggregate (the bench's stamp source): request
+        accounting, failover/shed/quarantine counters, per-replica
+        occupancy balance and the recovery-time distribution."""
+        st = self._stats
+        occ = [round(self._occ_sum[i] / self._occ_n[i], 4)
+               if self._occ_n[i] else None
+               for i in range(len(self._replicas))]
+        known = [o for o in occ if o is not None]
+        ttfts = np.asarray(st["ttft_s"], np.float64)
+        out = {
+            "replicas": len(self._replicas),
+            "healthy_replicas": sum(s == HEALTHY for s in self._status),
+            "ticks": self._ticks,
+            "submitted": st["submitted"],
+            "completed": st["completed"],
+            "shed_requests": st["shed_requests"],
+            "failed_requests": st["failed_requests"],
+            "failovers": st["failovers"],
+            "redispatched_requests": st["redispatched_requests"],
+            "quarantines": st["quarantines"],
+            "rejoins": st["rejoins"],
+            "hangs_detected": st["hangs_detected"],
+            "replicas_lost": st["replicas_lost"],
+            "served_by": dict(sorted(st["served_by"].items())),
+            "replica_occupancy": occ,
+            "occupancy_spread": (round(max(known) - min(known), 4)
+                                 if known else None),
+            "shed_rate": (round(st["shed_requests"]
+                                / st["submitted"], 4)
+                          if st["submitted"] else None),
+            # recovery = failover declared -> every redispatched stream
+            # delivering again. Ticks are the scheduler-step bound (the
+            # chaos suite's unit); seconds are the wall-clock truth (an
+            # idle router spins free ticks while the redispatch backoff
+            # gate runs down, so ticks alone can over-read)
+            "failover_recovery_ticks": (
+                max(st["failover_recovery_ticks"])
+                if st["failover_recovery_ticks"] else None),
+            "failover_recovery_s": (
+                max(st["failover_recovery_s"])
+                if st["failover_recovery_s"] else None),
+        }
+        if ttfts.size:
+            out["ttft_ms_p50"] = round(
+                float(np.percentile(ttfts, 50)) * 1e3, 3)
+            out["ttft_ms_p99"] = round(
+                float(np.percentile(ttfts, 99)) * 1e3, 3)
+        return out

@@ -27,6 +27,11 @@ from pytorchdistributed_tpu.telemetry.spans import SPAN_TRACE_FILE, SpanTracer
 SERVE_METRICS_FILE = "serve_metrics_rank{rank}.jsonl"
 SERVE_METRICS_GLOB = "serve_metrics_rank*.jsonl"
 
+# the replica ROUTER's stream (ISSUE 9): per-replica health/occupancy
+# rows, failover/shed/quarantine event rows, and the close-time summary
+ROUTER_METRICS_FILE = "router_metrics_rank{rank}.jsonl"
+ROUTER_METRICS_GLOB = "router_metrics_rank*.jsonl"
+
 
 class ServingTelemetry:
     """Span tracer + serving-metric JSONL sink for one engine/rank."""
@@ -74,6 +79,10 @@ class ServingTelemetry:
             "preemptions": getattr(req, "preemptions", 0),
             "draft_tokens": getattr(req, "draft_tokens", 0),
             "accepted_tokens": getattr(req, "accepted_tokens", 0),
+            # > 0 when this request RESUMED from tokens (router
+            # failover redispatch): the engine re-prefilled this many
+            # already-generated tokens and only decoded past them
+            "resumed_from": getattr(req, "resumed_from", 0),
         })
 
     def pool(self, **row) -> None:
@@ -86,6 +95,59 @@ class ServingTelemetry:
     def close(self) -> None:
         self.tracer.dump(os.path.join(
             self.run_dir, SPAN_TRACE_FILE.format(rank=self.rank)))
+        self.metrics.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class RouterTelemetry:
+    """The replica router's metric sink (ISSUE 9) — one JSONL stream per
+    router under ``router_metrics_rank{rank}.jsonl``, next to the
+    per-replica engines' own ``serve_metrics`` files. Three row kinds:
+
+      * ``replica`` — a per-replica health/load sample (status, active,
+        queued, occupancy, progress watermark) at the router's sampling
+        cadence;
+      * ``event``   — one lifecycle transition (failover, redispatch,
+        shed, quarantine, rejoin, drain) with its router tick: the
+        post-mortem trail of WHY streams moved between replicas;
+      * ``router``  — the close-time summary (failovers,
+        redispatched_requests, shed_requests, quarantines, rejoins,
+        per-replica occupancy balance) the report CLI's router table
+        renders.
+    """
+
+    def __init__(self, run_dir: str | os.PathLike, rank: int | None = None):
+        self.run_dir = str(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("RANK", "0")))
+        self.metrics = JsonlWriter(os.path.join(
+            self.run_dir, ROUTER_METRICS_FILE.format(rank=self.rank)))
+
+    @classmethod
+    def from_env(cls) -> "RouterTelemetry | None":
+        d = os.environ.get(TELEMETRY_DIR_ENV)
+        return cls(d) if d else None
+
+    def replica(self, **row) -> None:
+        self.metrics.write({"kind": "replica",
+                            "time": round(time.time(), 3), **row})
+
+    def event(self, event: str, **row) -> None:
+        self.metrics.write({"kind": "event", "event": event,
+                            "time": round(time.time(), 3), **row})
+
+    def summary(self, **row) -> None:
+        self.metrics.write({"kind": "router",
+                            "time": round(time.time(), 3), **row})
+
+    def close(self) -> None:
         self.metrics.close()
 
     def __enter__(self):

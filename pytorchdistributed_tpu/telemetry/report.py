@@ -256,6 +256,68 @@ def _serving_section(run_dir: str) -> list[str]:
     return lines
 
 
+def _router_section(run_dir: str) -> list[str]:
+    """The replica-router table (ISSUE 9): aggregate the
+    ``router_metrics_rank*.jsonl`` streams a ReplicaRouter leaves behind
+    — the close-time summary row plus per-replica status/occupancy and
+    the failover/quarantine event trail. Silent when no router ran."""
+    from pytorchdistributed_tpu.serving.telemetry import (
+        ROUTER_METRICS_GLOB,
+    )
+
+    rows_by_rank = _read_rank_rows(run_dir, ROUTER_METRICS_GLOB,
+                                   "router_metrics_rank")
+    if not rows_by_rank:
+        return []
+    lines = []
+    for rank, rows in sorted(rows_by_rank.items()):
+        summary = next((r for r in reversed(rows)
+                        if r.get("kind") == "router"), None)
+        events = [r for r in rows if r.get("kind") == "event"]
+        samples = [r for r in rows if r.get("kind") == "replica"]
+        lines.append(f"replica router (rank {rank}):")
+        if summary is not None:
+            shed = summary.get("shed_rate")
+            rec = summary.get("failover_recovery_ticks")
+            lines.append(
+                f"  submitted {summary.get('submitted', 0)}  "
+                f"completed {summary.get('completed', 0)}  "
+                f"shed {summary.get('shed_requests', 0)}"
+                + (f" ({shed:.1%})" if shed is not None else "")
+                + f"  failovers {summary.get('failovers', 0)}  "
+                f"redispatched {summary.get('redispatched_requests', 0)}  "
+                f"quarantines {summary.get('quarantines', 0)}  "
+                f"rejoins {summary.get('rejoins', 0)}"
+                + (f"  recovery {rec} ticks" if rec is not None else ""))
+        n_replicas = (summary.get("replicas") if summary
+                      else 1 + max((s.get("replica", 0)
+                                    for s in samples), default=0))
+        occ = (summary or {}).get("replica_occupancy") or []
+        served = {int(k): v for k, v in
+                  ((summary or {}).get("served_by") or {}).items()}
+        lines.append(f"  {'replica':>7}  {'status':>11}  {'served':>6}  "
+                     f"{'occupancy':>9}  {'failovers':>9}  "
+                     f"{'quarantines':>11}  {'rejoins':>7}")
+        for i in range(n_replicas or 0):
+            status = next((s.get("status", "-") for s in reversed(samples)
+                           if s.get("replica") == i), "-")
+            lost = sum(1 for e in events
+                       if e.get("event") == "replica_dead"
+                       and e.get("replica") == i)
+            quar = sum(1 for e in events
+                       if e.get("event") == "quarantine"
+                       and e.get("replica") == i)
+            rej = sum(1 for e in events
+                      if e.get("event") == "rejoin"
+                      and e.get("replica") == i)
+            o = occ[i] if i < len(occ) and occ[i] is not None else None
+            lines.append(
+                f"  {i:>7}  {status:>11}  {served.get(i, 0):>6}  "
+                f"{(f'{o:.2%}' if o is not None else '-'):>9}  "
+                f"{lost:>9}  {quar:>11}  {rej:>7}")
+    return lines
+
+
 def _device_trace_section(run_dir: str, top: int) -> list[str]:
     if not glob.glob(os.path.join(run_dir, "**", "*.trace.json.gz"),
                      recursive=True):
@@ -379,6 +441,12 @@ def render(run_dir: str | os.PathLike, *, top: int = 10) -> str:
     serving = _serving_section(run_dir)
     if serving:
         lines.extend(serving)
+        lines.append("")
+
+    # -- replica router -------------------------------------------------------
+    router = _router_section(run_dir)
+    if router:
+        lines.extend(router)
         lines.append("")
 
     # -- host spans ----------------------------------------------------------

@@ -775,7 +775,7 @@ def serving_lowered(name: str):
             prefill_model, weights_sds, cache_sds,
             sds((1, bucket), i32),                       # bucketed prompt
             sds((), i32), sds((), i32),                  # true_len, slot
-            sds(kd.shape, kd.dtype),
+            sds(kd.shape, kd.dtype), sds((), i32),       # key, count
             sds((), f32), sds((), i32), sds((), f32),    # sampling params
             candidates=candidates)
     return decode_tick.lower(
@@ -808,10 +808,15 @@ SERVE_COMMITTED: dict[str, dict] = {
                        "all-to-all": 0, "ragged-all-to-all": 0,
                        "collective-broadcast": 0},
     },
+    # serve_prefill*: recaptured 2026-08-04 after the resume-from-tokens
+    # count argument (ISSUE 9) joined the prefill signature — +4
+    # arg_bytes (one i32 scalar), +8 flops (the fold_in reads a dynamic
+    # count instead of a folded constant); alias/temp/collectives
+    # untouched.
     "serve_prefill": {
-        "flops": 22284180.0,
+        "flops": 22284188.0,
         "temp_bytes": 1253864,
-        "arg_bytes": 728652,
+        "arg_bytes": 728656,
         "alias_bytes": 262192,
         "collectives": {"all-reduce": 0, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,
@@ -839,9 +844,9 @@ SERVE_COMMITTED: dict[str, dict] = {
                        "collective-broadcast": 0},
     },
     "serve_prefill_int8fwd": {
-        "flops": 23949908.0,
+        "flops": 23949916.0,
         "temp_bytes": 1257192,
-        "arg_bytes": 728652,
+        "arg_bytes": 728656,
         "alias_bytes": 262192,
         "collectives": {"all-reduce": 0, "all-gather": 0,
                         "reduce-scatter": 0, "collective-permute": 0,

@@ -1,0 +1,535 @@
+"""Serving chaos suite: the replica router (serving/router.py, ISSUE 9).
+
+Correctness bar (the acceptance's chaos parity pin): with a replica
+killed MID-STREAM, every affected request's greedy token stream must be
+BITWISE-identical to the same trace on an uninterrupted single engine —
+the router's resume-from-tokens redispatch (submit(generated=...)
+re-prefilling prompt+generated) composes with the engine's existing
+bitwise-parity guarantees, so failover is invisible in the tokens. On
+top: hang detection within the tick-bounded watchdog, NaN quarantine +
+warmup rejoin, load shedding under overload with the router queue
+bounded throughout, SIGTERM drain finishing resident streams with no
+orphan replica, ZERO steady-state recompiles on survivors across a
+failover, and seeded-sampling determinism across a failover.
+
+Engine geometry mirrors tests/test_serving.py / test_paging.py (gpt2
+"test", 2 layers, max_seq_len 64, slots 3, bucket 16, paged block 8) so
+the compiled programs are shared across the suite's jit cache — the
+whole file rides a handful of compiles.
+"""
+
+import dataclasses
+import functools
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.faults.inject import (
+    FaultInjector,
+    FaultPlan,
+)
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import (
+    DEAD,
+    HEALTHY,
+    QUARANTINED,
+    ReplicaRouter,
+    SamplingParams,
+    ServingEngine,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.engine import (
+    decode_tick,
+    params_finite,
+    prefill_into_slot,
+)
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    return model, params, dm
+
+
+def _ref(prompt, n):
+    _, params, dm = _setup()
+    return np.asarray(generate(dm, params, jnp.asarray(prompt)[None],
+                               max_new_tokens=n))[0]
+
+
+def _prompts(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+            for m in (5, 9, 7, 11, 6, 8, 4, 10)[:n]]
+
+
+def _router(*, replicas=2, faults=None, paged=False, **kw):
+    model, params, _ = _setup()
+    ek = dict(num_slots=3, prefill_bucket=16)
+    if paged:
+        ek["block_size"] = 8
+    router = ReplicaRouter(model, params, replicas=replicas,
+                           engine_kwargs=ek, warmup_lens=(16, 32),
+                           faults=faults, **kw)
+    router.warmup()
+    return router
+
+
+# ----------------------------------------------------------------------
+# fault-spec plumbing (no jax work)
+
+def test_serving_fault_specs_parse_and_fire_once():
+    plan = FaultPlan.parse(
+        "replica_crash@tick=5,replica=0; replica_hang@tick=9; "
+        "replica_nan@tick=3,replica=1")
+    assert [s.describe() for s in plan.specs] == [
+        "replica_crash@tick=5,replica=0", "replica_hang@tick=9",
+        "replica_nan@tick=3,replica=1"]
+    inj = FaultInjector(plan)
+    assert inj.on_serving_tick(5, 0) == "replica_crash"
+    assert inj.on_serving_tick(5, 0) is None        # one-shot
+    assert inj.on_serving_tick(9, 2) == "replica_hang"  # any replica
+    assert inj.on_serving_tick(3, 0) is None        # wrong replica
+    assert inj.on_serving_tick(3, 1) == "replica_nan"
+    with pytest.raises(ValueError, match="needs tick="):
+        FaultPlan.parse("replica_crash@replica=0")
+    with pytest.raises(ValueError, match="only apply to serving"):
+        FaultPlan.parse("crash@step=2,tick=3")
+
+
+# ----------------------------------------------------------------------
+# engine satellite: resume-from-tokens
+
+def test_engine_resume_from_tokens_dense_and_paged():
+    """submit(generated=...) continues a greedy stream bitwise from any
+    split point — the failover primitive, factored from the paged
+    preempt-resume path and extended to the dense engine (whose prefill
+    now carries the fold_in count as a dynamic arg)."""
+    model, params, _ = _setup()
+    prompt = _prompts(1)[0]
+    full = _ref(prompt, 8)[prompt.size:]
+    for paged in (False, True):
+        kw = dict(block_size=8) if paged else {}
+        engine = ServingEngine(model, params, num_slots=3,
+                               prefill_bucket=16, **kw)
+        engine.warmup(prompt_lens=(16, 32))
+        for cut in (1, 4, 7):
+            fresh = []
+            r = engine.submit(prompt, max_new_tokens=8,
+                              generated=full[:cut],
+                              on_token=lambda _, t: fresh.append(t))
+            engine.run_until_idle()
+            assert r.finish_reason == "length"
+            assert r.resumed_from == cut
+            np.testing.assert_array_equal(
+                r.output_ids, np.concatenate([prompt, full]),
+                err_msg=f"paged={paged} cut={cut}")
+            # only the continuation is DELIVERED — the client already
+            # holds the resumed prefix
+            assert fresh == list(full[cut:])
+        # stream() honors the same contract: no prefix replay
+        r = engine.submit(prompt, max_new_tokens=8, generated=full[:4])
+        assert list(engine.stream(r)) == list(full[4:])
+        engine.close()
+
+
+def test_engine_resume_seeded_sampling_continues_stream():
+    """A sampled stream resumed from tokens continues its seeded
+    fold_in sequence exactly — deterministic-seed redispatch."""
+    model, params, _ = _setup()
+    prompt = _prompts(1)[0]
+    sampling = SamplingParams(temperature=0.8, top_k=10, seed=123)
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16)
+    engine.warmup(prompt_lens=(16, 32))
+    a = engine.submit(prompt, max_new_tokens=8, sampling=sampling)
+    engine.run_until_idle()
+    b = engine.submit(prompt, max_new_tokens=8, sampling=sampling,
+                      generated=a.new_tokens[:3])
+    engine.run_until_idle()
+    assert b.new_tokens == a.new_tokens
+    with pytest.raises(ValueError, match="nothing left"):
+        engine.submit(prompt, max_new_tokens=3, generated=[1, 2, 3])
+    engine.close()
+
+
+def test_engine_health_snapshot_and_finite_probe():
+    model, params, _ = _setup()
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16)
+    engine.warmup(prompt_lens=(16,))
+    h = engine.health()
+    assert h["alive"] and not h["sick"] and h["active"] == 0
+    assert h["progress"] > 0  # warmup's compiled calls moved it
+    p0 = h["progress"]
+    engine.submit(_prompts(1)[0], max_new_tokens=3)
+    engine.step()
+    assert engine.health()["progress"] > p0
+    assert engine.check_params_finite()
+    good = engine._weights
+    engine.set_params(jax.tree_util.tree_map(
+        lambda x: (jnp.full_like(x, jnp.nan)
+                   if jnp.issubdtype(x.dtype, jnp.inexact) else x), good))
+    assert not engine.check_params_finite()
+    assert engine.health()["sick"]
+    engine.set_params(good)
+    assert engine.check_params_finite()
+    assert not engine.health()["sick"]
+    engine.close()
+
+
+# ----------------------------------------------------------------------
+# chaos: crash mid-stream
+
+def _assert_crash_parity(paged: bool):
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = _router(faults=inj, paged=paged)
+    prompts = _prompts(5)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    s = router.summary()
+    assert s["replicas_lost"] == 1 and s["failovers"] == 1
+    assert s["redispatched_requests"] >= 1
+    assert s["failover_recovery_ticks"] is not None
+    for p, r in zip(prompts, reqs):
+        assert r.finish_reason == "length"
+        np.testing.assert_array_equal(
+            r.output_ids,
+            np.concatenate([p, _ref(p, 8)[p.size:]]),
+            err_msg=f"request {r.id} (replicas {r.replicas})")
+    # at least one stream actually moved replicas mid-flight
+    assert any(len(r.replicas) > 1 for r in reqs)
+    router.close()  # survivors assert their pool-leak invariant
+
+
+def test_crash_midstream_greedy_bitwise_dense():
+    """THE chaos parity pin: kill replica 0 while it streams; every
+    affected request is redispatched (prompt + generated re-prefilled on
+    a survivor) and the delivered greedy stream is bitwise what an
+    uninterrupted single engine produces."""
+    _assert_crash_parity(paged=False)
+
+
+def test_crash_midstream_greedy_bitwise_paged():
+    """Same pin on PAGED replicas — failover composes with block-table
+    paging, and the surviving engines' close() re-asserts the pool leak
+    invariant after absorbing the redispatched load."""
+    _assert_crash_parity(paged=True)
+
+
+def test_retry_budget_exhausted_fails_request():
+    """max_retries=0: a crash's victims are FAILED (finish_reason
+    "failed", done=True, partial tokens retained) instead of retried —
+    the budget bounds how many deaths one request may surf."""
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = _router(faults=inj, max_retries=0)
+    prompts = _prompts(5)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    s = router.summary()
+    assert s["failed_requests"] >= 1
+    failed = [r for r in reqs if r.finish_reason == "failed"]
+    assert failed and all(r.done for r in reqs)
+    ok = [r for r in reqs if r.finish_reason == "length"]
+    for r in ok:
+        np.testing.assert_array_equal(
+            r.output_ids,
+            np.concatenate([r.prompt, _ref(r.prompt, 8)[r.prompt.size:]]))
+    router.close()
+
+
+# ----------------------------------------------------------------------
+# chaos: hang
+
+def test_hang_detected_within_watchdog_bound():
+    """A silently frozen replica (progress watermark stops while it
+    holds streams) is declared hung within hang_ticks router ticks of
+    the freeze, and its streams fail over losslessly."""
+    hang_ticks = 4
+    inj = FaultInjector(FaultPlan.parse("replica_hang@tick=3,replica=1"))
+    router = _router(faults=inj, hang_ticks=hang_ticks)
+    prompts = _prompts(5)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    detected_at = None
+    steps = 0
+    while router.queue_depth or router.in_flight:
+        router.step()
+        steps += 1
+        if detected_at is None and router._status[1] == DEAD:
+            detected_at = router._ticks
+        assert steps < 2000
+    assert detected_at is not None, "hang never detected"
+    assert detected_at <= 3 + hang_ticks + 1, detected_at
+    s = router.summary()
+    assert s["hangs_detected"] == 1
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(
+            r.output_ids, np.concatenate([p, _ref(p, 8)[p.size:]]))
+    router.close()
+
+
+# ----------------------------------------------------------------------
+# chaos: NaN quarantine + rejoin
+
+def test_nan_replica_quarantined_then_rejoins_after_warmup():
+    """Poisoned params trip the finite probe: the replica is
+    quarantined (streams redispatched before any garbage token is
+    delivered at health_every=1), probed while parked, and — once
+    repaired — rejoined after a clean-probe streak plus a warmup canary
+    run end-to-end. Traffic then flows to it again, still bitwise."""
+    inj = FaultInjector(FaultPlan.parse("replica_nan@tick=4,replica=0"))
+    router = _router(faults=inj, health_every=1, rejoin_after=2)
+    prompts = _prompts(4)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    repaired = False
+    steps = 0
+    while router.queue_depth or router.in_flight:
+        router.step()
+        steps += 1
+        if not repaired and router._status[0] == QUARANTINED:
+            router._replicas[0].restore_params()  # the operator's fix
+            repaired = True
+        assert steps < 2000
+    assert repaired, "quarantine never happened"
+    s = router.summary()
+    assert s["quarantines"] == 1
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(
+            r.output_ids, np.concatenate([p, _ref(p, 8)[p.size:]]),
+            err_msg=f"request {r.id}")
+    # keep ticking until the rejoin (probe streak + canary)
+    for _ in range(50):
+        if router._status[0] == HEALTHY:
+            break
+        router.step()
+    assert router._status[0] == HEALTHY
+    assert router.summary()["rejoins"] == 1
+    again = [router.submit(p, max_new_tokens=4) for p in prompts]
+    router.run_until_idle()
+    assert 0 in {r._replica for r in again}, "rejoined replica unused"
+    for p, r in zip(prompts, again):
+        np.testing.assert_array_equal(
+            r.output_ids, np.concatenate([p, _ref(p, 4)[p.size:]]))
+    router.close()
+
+
+# ----------------------------------------------------------------------
+# load shedding
+
+def test_shed_under_overload_keeps_queue_bounded():
+    """A burst beyond capacity: excess submits are refused immediately
+    with finish_reason "shed" (no tokens, no prefill paid), the router
+    queue NEVER exceeds its bound (that is the p99-TTFT protection —
+    admitted requests wait a bounded line, not an unbounded one), and
+    every admitted request completes bitwise-correct."""
+    router = _router(max_queue=2)
+    prompts = _prompts(8, seed=3)
+    reqs = []
+    for p in prompts + prompts:          # 16 >> 2 replicas x (3+1) + 2
+        reqs.append(router.submit(p, max_new_tokens=6))
+        assert len(router._queue) <= 2
+    shed = [r for r in reqs if r.finish_reason == "shed"]
+    assert shed, "overload never shed"
+    assert all(r.done and not r.tokens for r in shed)
+    while router.queue_depth or router.in_flight:
+        router.step()
+        assert len(router._queue) <= 2
+    s = router.summary()
+    assert s["shed_requests"] == len(shed)
+    assert s["shed_rate"] == round(len(shed) / len(reqs), 4)
+    assert s["ttft_ms_p99"] is not None
+    served = [r for r in reqs if r.finish_reason == "length"]
+    assert len(served) == len(reqs) - len(shed)
+    for r in served:
+        np.testing.assert_array_equal(
+            r.output_ids,
+            np.concatenate([r.prompt, _ref(r.prompt, 6)[r.prompt.size:]]))
+    router.close()
+
+
+# ----------------------------------------------------------------------
+# SIGTERM drain
+
+def test_sigterm_drain_finishes_resident_streams_no_orphans():
+    """The PR 4 no-orphans assertion pattern, router-shaped: SIGTERM →
+    request_drain → the next step drains: resident streams FINISH
+    (full budget, bitwise), queued ones are refused as "drained", and
+    close() walks every replica's leak invariant — nothing is left
+    holding blocks or slots."""
+    router = _router()
+    prev = signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    try:
+        router.install_sigterm_drain()
+        prompts = _prompts(5, seed=7)
+        resident, queued = [], []
+        for p in prompts:
+            resident.append(router.submit(p, max_new_tokens=6))
+        for _ in range(2):
+            router.step()   # all five are placed and streaming
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert router._draining     # handler ran, drain deferred
+        queued.append(router.submit(prompts[0], max_new_tokens=6))
+        router.step()               # performs the drain
+        for r in resident:
+            assert r.done and r.finish_reason == "length"
+            np.testing.assert_array_equal(
+                r.output_ids,
+                np.concatenate([r.prompt,
+                                _ref(r.prompt, 6)[r.prompt.size:]]))
+        assert queued[0].finish_reason == "drained"
+        assert router.in_flight == 0 and router.queue_depth == 0
+        router.close()  # leak invariant on every replica
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ----------------------------------------------------------------------
+# zero recompiles + determinism across failover
+
+def test_zero_steadystate_recompiles_across_failover():
+    """Surviving replicas absorb the redispatched load with ZERO
+    retraces and ZERO recompiles: resume-from-tokens rides the warmed
+    prefill buckets and the same tick program, and the health probe is
+    compiled at warmup — TRACE_COUNTS and the pjit _cache_size are the
+    tripwires, exactly like the engine's own steady-state guarantee."""
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = _router(faults=inj)
+    traces = dict(serving_engine.TRACE_COUNTS)
+    sizes = (decode_tick._cache_size(), prefill_into_slot._cache_size(),
+             params_finite._cache_size())
+    prompts = _prompts(5)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    assert router.summary()["redispatched_requests"] >= 1
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    assert (decode_tick._cache_size(), prefill_into_slot._cache_size(),
+            params_finite._cache_size()) == sizes
+    router.close()
+
+
+def test_seeded_sampling_determinism_across_failover():
+    """Sampled streams are a function of (prompt, params, seed) alone —
+    a mid-stream crash and redispatch reproduces the same tokens the
+    single uninterrupted engine samples, because the resume prefill
+    continues the per-token fold_in count where the victim stopped."""
+    model, params, _ = _setup()
+    prompts = _prompts(4, seed=5)
+    sampling = [SamplingParams(temperature=0.8, top_k=10, seed=100 + i)
+                for i in range(4)]
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16)
+    engine.warmup(prompt_lens=(16, 32))
+    want = []
+    for p, s in zip(prompts, sampling):
+        r = engine.submit(p, max_new_tokens=8, sampling=s)
+        engine.run_until_idle()
+        want.append(list(r.new_tokens))
+    engine.close()
+
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = _router(faults=inj)
+    reqs = [router.submit(p, max_new_tokens=8, sampling=s)
+            for p, s in zip(prompts, sampling)]
+    router.run_until_idle()
+    assert router.summary()["redispatched_requests"] >= 1
+    assert [r.tokens for r in reqs] == want
+    router.close()
+
+
+# ----------------------------------------------------------------------
+# telemetry + report
+
+def test_router_telemetry_rows_and_report_table(tmp_path):
+    """The router's JSONL stream carries per-replica rows, lifecycle
+    event rows and the close-time summary; the report CLI renders the
+    per-replica table with failover counts."""
+    from pytorchdistributed_tpu.serving.telemetry import (
+        ROUTER_METRICS_FILE,
+    )
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = _router(faults=inj, telemetry_dir=str(tmp_path), max_queue=2)
+    prompts = _prompts(6, seed=9)
+    for p in prompts + prompts:
+        router.submit(p, max_new_tokens=6)
+    router.run_until_idle()
+    router.close()
+
+    rows = [json.loads(x) for x in
+            (tmp_path / ROUTER_METRICS_FILE.format(rank=0))
+            .read_text().strip().splitlines()]
+    kinds = {r["kind"] for r in rows}
+    assert kinds == {"replica", "event", "router"}
+    events = {r["event"] for r in rows if r["kind"] == "event"}
+    assert {"replica_dead", "redispatch", "shed"} <= events
+    summary = [r for r in rows if r["kind"] == "router"][-1]
+    assert summary["failovers"] == 1
+    assert summary["shed_requests"] >= 1
+    assert summary["redispatched_requests"] >= 1
+    assert len(summary["replica_occupancy"]) == 2
+
+    report = render(str(tmp_path))
+    assert "replica router" in report
+    assert "dead" in report and "healthy" in report
+    assert "redispatched" in report
+
+
+# ----------------------------------------------------------------------
+# subprocess mode (full tier: spawns real workers that import jax)
+
+def test_subprocess_replicas_crash_failover_no_orphans(monkeypatch,
+                                                      tmp_path):
+    """The multi-host shape: replicas as run.py-env-contract subprocess
+    workers, PTD_FAULTS crashing worker 0 from INSIDE (os._exit
+    mid-protocol). The router sees the death, redispatches, the stream
+    stays bitwise, and teardown leaves no orphan process."""
+    import time
+
+    from pytorchdistributed_tpu.faults import inject as faults_inject
+
+    monkeypatch.setenv("PTD_FAULTS", "replica_crash@tick=4,replica=0")
+    monkeypatch.setenv("PTD_FAULTS_STATE", str(tmp_path / "faults"))
+    faults_inject.reset_active()
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 2, "prefill_bucket": 16}}
+    router = ReplicaRouter(workers=[spec, spec], warmup_lens=(16, 32),
+                           faults=None)
+    try:
+        router.warmup()
+        model = GPT2(CFG)
+        params = jax.jit(model.init)(jax.random.key(1),
+                                     jnp.zeros((1, 8), jnp.int32))
+        dm = GPT2(dataclasses.replace(CFG, decode=True))
+        prompts = _prompts(4)
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        assert router.summary()["replicas_lost"] == 1
+        # the run.py liveness contract rode along: the surviving
+        # worker's heartbeat file is fresh in the health snapshot
+        age = router.health()[1].get("heartbeat_age_s")
+        assert age is not None and age < 60.0, age
+        for p, r in zip(prompts, reqs):
+            ref = np.asarray(generate(dm, params, jnp.asarray(p)[None],
+                                      max_new_tokens=6))[0]
+            np.testing.assert_array_equal(r.output_ids, ref,
+                                          err_msg=f"request {r.id}")
+        procs = [rep.proc for rep in router._replicas]
+    finally:
+        router.close()
+        faults_inject.reset_active()
+    deadline = time.time() + 15
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    assert all(p.poll() is not None for p in procs), \
+        [p.poll() for p in procs]
