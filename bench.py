@@ -1046,6 +1046,133 @@ def bench_router() -> dict:
     return result
 
 
+def _coldstart_worker(cache_dir: str) -> None:
+    """Child of bench_coldstart: ONE fresh process standing up a serving
+    engine against ``cache_dir`` (jax import → model init → engine →
+    warmup → first token), printing one JSON line with the wall-time
+    breakdown, the greedy token stream (the parent asserts cold == warm
+    bitwise) and the compile tripwires: engine TRACE_COUNTS, the jit
+    wrappers' pjit ``_cache_size`` sum, and the compile-cache stats —
+    on a warm run every one of them must read ZERO fresh compiles."""
+    t_start = time.perf_counter()
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.compile_cache import stats_snapshot
+    from pytorchdistributed_tpu.serving import ServingEngine
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    size = os.environ.get("PTD_COLDSTART_SIZE", "test")
+    num_slots = int(os.environ.get("PTD_COLDSTART_SLOTS", "4"))
+    paged = os.environ.get("PTD_COLDSTART_PAGED", "0") == "1"
+    block = int(os.environ.get("PTD_COLDSTART_BLOCK", "16"))
+    cfg = gpt2_config(size, scan_layers=False, quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    t_built = time.perf_counter()
+    engine = ServingEngine(model, params, num_slots=num_slots,
+                           prefill_bucket=128,
+                           block_size=block if paged else 0,
+                           compile_cache=cache_dir)
+    engine.warmup(prompt_lens=(128,))
+    t_warm = time.perf_counter()
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, cfg.vocab_size, (64,)).astype(np.int32)
+    req = engine.submit(prompt, max_new_tokens=8)
+    stream = engine.stream(req)
+    first = next(stream)
+    t_first = time.perf_counter()
+    tokens = [int(first)] + [int(t) for t in stream]
+    outcomes = dict(engine.aot_outcomes)
+    engine.close()
+    jit_cache = sum(f._cache_size() for f in (
+        serving_engine.decode_tick, serving_engine.prefill_into_slot,
+        serving_engine.paged_decode_tick,
+        serving_engine.paged_prefill_chunk,
+        serving_engine.spec_decode_tick, serving_engine.params_finite))
+    print(json.dumps({
+        "start_to_first_token_s": round(t_first - t_start, 4),
+        "model_build_s": round(t_built - t_start, 4),
+        "warmup_s": round(t_warm - t_built, 4),
+        "tokens": tokens,
+        "trace_counts": dict(serving_engine.TRACE_COUNTS),
+        "jit_cache_size": jit_cache,
+        "cache_stats": stats_snapshot(),
+        "aot_outcomes": outcomes,
+    }))
+
+
+def bench_coldstart() -> dict:
+    """Cold start vs warm start A/B for the persistent AOT executable
+    cache (ISSUE 10, runtime/compile_cache.py): two FRESH subprocesses
+    stand up the same serving engine against the same cache directory —
+    the first compiles + serializes every program (cold), the second
+    deserializes them (warm). The headline is the start-to-first-token
+    speedup; the record asserts-by-stamping that the warm run performed
+    **zero** XLA compiles (``warm_fresh_compiles`` must be 0 — pinned
+    three ways: compile-cache miss/store counters, engine TRACE_COUNTS,
+    and the jit wrappers' pjit ``_cache_size``, all read inside the
+    warm child) and that the two runs' greedy token streams are bitwise
+    identical (``tokens_bitwise_equal``). Knobs:
+    PTD_COLDSTART_{SIZE,SLOTS,PAGED,BLOCK,CACHE}; PTD_QUANT rides the
+    model config like every serving bench."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    cache_dir = (os.environ.get("PTD_COLDSTART_CACHE")
+                 or tempfile.mkdtemp(prefix="ptd_coldstart_cache_"))
+
+    def leg() -> dict:
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--coldstart-worker", cache_dir],
+            capture_output=True, text=True)
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            print(f"coldstart worker failed:\n{proc.stderr}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out["process_wall_s"] = round(wall, 4)
+        return out
+
+    cold = leg()
+    warm = leg()
+    warm_fresh = (warm["cache_stats"].get("miss", 0)
+                  + warm["cache_stats"].get("store", 0)
+                  + warm["jit_cache_size"]
+                  + sum(warm["trace_counts"].values()))
+    cold_s = cold["start_to_first_token_s"]
+    warm_s = warm["start_to_first_token_s"]
+    result = {
+        "metric": "serve_coldstart_speedup",
+        "value": round(cold_s / warm_s, 2) if warm_s else None,
+        "unit": "x",
+        "cold_start_to_first_token_s": cold_s,
+        "warm_start_to_first_token_s": warm_s,
+        "cold_warmup_s": cold["warmup_s"],
+        "warm_warmup_s": warm["warmup_s"],
+        "cold_compiles": cold["cache_stats"].get("store", 0),
+        "warm_cache_hits": warm["cache_stats"].get("hit", 0),
+        "warm_fresh_compiles": warm_fresh,           # must stamp 0
+        "tokens_bitwise_equal": cold["tokens"] == warm["tokens"],
+        "cache_entries": sum(1 for f in os.listdir(cache_dir)
+                             if f.endswith(".json")),
+        "cache_dir": cache_dir,
+    }
+    _stamp_overrides(result, ("PTD_COLDSTART_SIZE", "PTD_COLDSTART_SLOTS",
+                              "PTD_COLDSTART_PAGED", "PTD_COLDSTART_BLOCK",
+                              "PTD_COLDSTART_CACHE", "PTD_QUANT"))
+    return result
+
+
 def bench_mlp() -> dict:
     import optax
 
@@ -1407,6 +1534,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
            "serve": bench_serve, "router": bench_router,
+           "coldstart": bench_coldstart,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 
@@ -1460,9 +1588,14 @@ def main() -> None:
                         help=argparse.SUPPRESS)  # bench_scaling_sim child
     parser.add_argument("--scaling-sim-mode", type=str, default="dp",
                         help=argparse.SUPPRESS)
+    parser.add_argument("--coldstart-worker", type=str, default=None,
+                        help=argparse.SUPPRESS)  # bench_coldstart child
     args = parser.parse_args()
     if args.scaling_sim_worker is not None:
         _scaling_sim_worker(args.scaling_sim_worker, args.scaling_sim_mode)
+        return
+    if args.coldstart_worker is not None:
+        _coldstart_worker(args.coldstart_worker)
         return
     if args.bench not in CPU_SIM_BENCHES:
         _probe_device()

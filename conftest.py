@@ -182,6 +182,31 @@ _QUICK = (
     "test_router.py::test_zero_steadystate_recompiles_across_failover",
     "test_router.py::test_seeded_sampling_determinism_across_failover",
     "test_router.py::test_router_telemetry_rows_and_report_table",
+    # elastic recovery (ISSUE 10): compile-cache core units (key
+    # anatomy, round-trip, quarantine-on-defect, publish race), the
+    # engine/trainer warm-start zero-compile + bitwise anchors, the
+    # CLI (ls/verify/gc/prewarm), the replica-worker checkpoint key,
+    # and the in-process router auto-respawn pair — all on the
+    # suite-shared test-size geometry. The SUBPROCESS respawn e2e
+    # (spawns jax-importing workers) stays full-tier-only.
+    "test_compile_cache.py::test_key_components_all_enter_the_digest",
+    "test_compile_cache.py::test_roundtrip_miss_then_hit_bitwise",
+    "test_compile_cache.py::test_corrupt_payload_quarantined_then_clean",
+    "test_compile_cache.py::test_version_mismatch_quarantined",
+    "test_compile_cache.py::test_concurrent_publish_race_is_safe",
+    "test_compile_cache.py::test_engine_warm_start_zero_compiles_bitwise",
+    "test_compile_cache.py::test_engine_paged_warm_start_bitwise",
+    "test_compile_cache.py::test_warmup_collapses_to_one_round_with_cache",
+    "test_compile_cache.py::test_cache_failure_falls_back_to_jit",
+    "test_compile_cache.py::test_cli_ls_verify_gc",
+    "test_compile_cache.py::test_cli_prewarm_then_worker_starts_all_hits",
+    "test_compile_cache.py::test_worker_checkpoint_key_restores",
+    "test_compile_cache.py::test_worker_checkpoint_absent_falls_back",
+    "test_compile_cache.py::test_trainer_warm_restart_zero_jit_compiles",
+    "test_compile_cache.py::test_trainer_cache_keyed_on_lowered_hlo",
+    "test_compile_cache.py::test_router_respawn_rejoins_and_serves",
+    "test_compile_cache.py::test_router_respawn_budget_exhausts",
+    "test_compile_cache.py::test_respawn_warmup_timeout_declares",
 )
 
 

@@ -51,6 +51,14 @@ def main():
                              "truncating the trained model to its first "
                              "N layers (0 = self-draft with the full "
                              "model, acceptance ~1)")
+    parser.add_argument("--compile-cache", type=str, default=None,
+                        help="persistent AOT executable cache directory "
+                             "(README 'Cold start & elastic recovery'): "
+                             "first run compiles + serializes every "
+                             "serving program, later runs deserialize "
+                             "them — restart reaches its first token "
+                             "with zero XLA compiles. PTD_COMPILE_CACHE "
+                             "works too")
     parser.add_argument("--replicas", type=int, default=1,
                         help="> 1: serve through the health-checked "
                              "ReplicaRouter over this many in-process "
@@ -120,7 +128,9 @@ def main():
             engine_kwargs=dict(num_slots=args.num_slots,
                                prefill_bucket=16,
                                block_size=args.block_size,
-                               spec_k=args.spec_k, **spec_kw),
+                               spec_k=args.spec_k,
+                               compile_cache=args.compile_cache or "auto",
+                               **spec_kw),
             warmup_lens=(16,), telemetry_dir=args.telemetry_dir,
             **router_kw)
         router.warmup()
@@ -150,7 +160,8 @@ def main():
         model, params,
         num_slots=args.num_slots, prefill_bucket=16,
         block_size=args.block_size, spec_k=args.spec_k, **spec_kw,
-        telemetry_dir=args.telemetry_dir)
+        telemetry_dir=args.telemetry_dir,
+        compile_cache=args.compile_cache or "auto")
     engine.warmup(prompt_lens=(16,))
 
     # staggered mixed-length traffic: more requests than slots, per-request

@@ -11,3 +11,7 @@ from pytorchdistributed_tpu.runtime.dist import (  # noqa: F401
     get_world_size,
     is_initialized,
 )
+from pytorchdistributed_tpu.runtime.compile_cache import (  # noqa: F401
+    COMPILE_CACHE_DIR_ENV,
+    CompileCache,
+)

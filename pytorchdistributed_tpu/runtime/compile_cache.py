@@ -1,0 +1,572 @@
+"""Persistent AOT executable cache — seconds-scale restart for every
+relaunch path (ROADMAP item 5; the elastic half of ISSUE 10).
+
+Every recovery mechanism this repo already has — crash/preempt restarts
+(PR 4), replica failover (PR 9), new replicas joining a fleet — pays
+full retrace + XLA compile on the way back up: a "recovered" process is
+minutes away from its first token/step. The torchrun elastic-agent
+contract this repo reproduces assumes relaunch is CHEAP; this module is
+what makes that true on the XLA side:
+
+  * programs are compiled **ahead of time** (``jit_fn.lower(...)
+    .compile()`` — the same pjit ``Lowered``/``Compiled`` stages the
+    compiled-invariant pins already read) and the executables
+    **serialized to disk** (`jax.experimental.serialize_executable`);
+  * entries are keyed by everything that could invalidate them —
+    jax/jaxlib version, backend + topology fingerprint, program name,
+    a caller config hash, the donation signature, and the full
+    avals/shardings signature of the example arguments — so a wrong
+    hit is structurally impossible: anything that would change the
+    program changes the key;
+  * each entry carries a sha256 **manifest** (the checkpoint-manifest
+    style of training/checkpoint.py) written atomically
+    (tmp + ``os.replace``) AFTER the payload, so the manifest is the
+    commit point and concurrent replicas racing to publish the same
+    entry are safe: both write identical content, last rename wins;
+  * the contract is **never-fails**: version mismatch, checksum
+    mismatch, a torn write, an unpicklable payload, a backend that
+    cannot deserialize — every load-side failure QUARANTINES the entry
+    (moved to ``quarantine/``, post-mortem evidence like corrupt
+    checkpoints) and returns None, and the caller falls back to a
+    fresh compile. A cache can make a restart slow again; it can never
+    make it wrong or dead.
+
+Wired callers: ``ServingEngine`` (tick/prefill/spec/probe programs —
+warmup collapses to one deserialized-executable probe round per
+bucket), ``Trainer`` (the train-step executable: ``step_accounting``'s
+AOT compile and the hot-loop step itself dispatch through the cache),
+and ``serving/replica_worker.py`` (spec key ``"compile_cache"``) so a
+router-respawned replica rejoins in load-bound seconds. Every
+hit/miss/store/quarantine is a TelemetryEvent (EVENT_COMPILE_CACHE).
+
+Offline CLI::
+
+    python -m pytorchdistributed_tpu.runtime.compile_cache ls <dir>
+    python -m pytorchdistributed_tpu.runtime.compile_cache verify <dir>
+    python -m pytorchdistributed_tpu.runtime.compile_cache gc <dir> \
+        [--max-age-days D] [--keep N]
+    python -m pytorchdistributed_tpu.runtime.compile_cache prewarm <dir> \
+        --spec '{"model": "gpt2", "size": "test", ...}'
+
+``prewarm`` compiles + serializes every program a replica-worker spec
+would need (all prefill buckets + the tick family) BEFORE deploy, so
+the first real worker to start finds a fully warm cache.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import pathlib
+import pickle
+import time
+
+import jax
+
+from pytorchdistributed_tpu.faults.retry import IO_RETRY, RetryPolicy, retry
+from pytorchdistributed_tpu.telemetry.events import (
+    EVENT_COMPILE_CACHE,
+    EventLog,
+)
+
+#: env contract: point every process of a deployment (trainer workers,
+#: serving replicas, the router's respawned workers) at one shared
+#: cache directory — next to the checkpoint dir is the natural home
+COMPILE_CACHE_DIR_ENV = "PTD_COMPILE_CACHE"
+
+QUARANTINE_DIR = "quarantine"
+
+#: process-global outcome counters (hit / miss / store / quarantined /
+#: serialize_unsupported / store_failed / exec_failed) — the tests' and
+#: the coldstart bench's zero-fresh-compiles tripwire reads these the
+#: way serving tests read engine TRACE_COUNTS.
+CACHE_STATS: collections.Counter = collections.Counter()
+
+
+class _CacheEntryError(RuntimeError):
+    """Internal: positive evidence an on-disk entry is unusable (version
+    drift, checksum mismatch, torn files) — always quarantined, never
+    propagated."""
+
+
+def backend_fingerprint() -> dict:
+    """The topology half of the cache key: platform, device kinds and
+    counts, process count. A serialized executable embeds device
+    assignments, so an entry must never be offered to a different
+    backend shape."""
+    devices = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_kinds": sorted({d.device_kind for d in devices}),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+
+
+def _leaf_signature(leaf) -> list:
+    shape = list(getattr(leaf, "shape", ()))
+    dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+    sharding = getattr(leaf, "sharding", None)
+    return [shape, dtype, repr(sharding) if sharding is not None else ""]
+
+
+def args_signature(example_args) -> dict:
+    """Avals + shardings + tree structure of the program's dynamic
+    arguments (jax.Arrays or ShapeDtypeStructs both carry all three) —
+    the part of the key that pins the executable to its exact calling
+    convention."""
+    leaves, treedef = jax.tree_util.tree_flatten(example_args)
+    return {"treedef": str(treedef),
+            "leaves": [_leaf_signature(x) for x in leaves]}
+
+
+def static_repr(value) -> str:
+    """Stable string for a static argument. Flax modules hash by
+    identity, which is useless across processes — their config
+    dataclass repr is the portable identity (two clones with equal
+    configs lower to the same program)."""
+    cfg = getattr(value, "cfg", None)
+    if cfg is not None:
+        return f"{type(value).__name__}({cfg!r})"
+    return repr(value)
+
+
+class CompileCache:
+    """One persistent executable-cache directory.
+
+    ``load_or_compile(name, compile_fn, example_args, ...)`` is the
+    whole integration surface: compute the key, try to deserialize a
+    committed entry (any failure quarantines it and falls through),
+    otherwise run ``compile_fn()`` (the caller's ``lower().compile()``
+    thunk) and publish the result. The returned ``jax.stages.Compiled``
+    is called with the program's DYNAMIC arguments only (statics are
+    baked into the executable).
+    """
+
+    def __init__(self, directory, *, rank: int | None = None,
+                 events: EventLog | None | str = "auto",
+                 retry_policy: RetryPolicy = IO_RETRY):
+        self.directory = pathlib.Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        if rank is None:
+            rank = int(os.environ.get("RANK", "0"))
+        self.rank = rank
+        self._events = (EventLog.from_env(rank) if events == "auto"
+                        else events)
+        self._retry_policy = retry_policy
+
+    # -- construction helpers ------------------------------------------
+
+    @classmethod
+    def from_env(cls) -> "CompileCache | None":
+        """The PTD_COMPILE_CACHE contract (None when unset) — how
+        launched workers opt in without code changes."""
+        d = os.environ.get(COMPILE_CACHE_DIR_ENV)
+        return cls(d) if d else None
+
+    @classmethod
+    def resolve(cls, value) -> "CompileCache | None":
+        """Normalize a user-facing knob: an instance passes through,
+        "auto" reads the env contract, None/""/"off" disables, a path
+        opens that directory."""
+        if value is None or value == "" or value == "off":
+            return None
+        if isinstance(value, cls):
+            return value
+        if value == "auto":
+            return cls.from_env()
+        return cls(value)
+
+    # -- keys ----------------------------------------------------------
+
+    def entry_key(self, name: str, example_args, *, statics: str = "",
+                  config_hash: str = "",
+                  donation: str = "") -> tuple[dict, str]:
+        """(key components, sha256 digest). Everything that could
+        invalidate a serialized executable is IN the key, so staleness
+        can only ever manifest as a miss."""
+        import jaxlib
+
+        key = {
+            "v": 1,
+            "name": name,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": backend_fingerprint(),
+            "statics": statics,
+            "config": config_hash,
+            "donation": donation,
+            "args": args_signature(example_args),
+        }
+        digest = hashlib.sha256(
+            json.dumps(key, sort_keys=True).encode()).hexdigest()
+        return key, digest
+
+    def _paths(self, digest: str) -> tuple[pathlib.Path, pathlib.Path]:
+        return (self.directory / f"{digest}.bin",
+                self.directory / f"{digest}.json")
+
+    # -- load ----------------------------------------------------------
+
+    def load(self, name: str, example_args, *, statics: str = "",
+             config_hash: str = "", donation: str = ""):
+        key, digest = self.entry_key(name, example_args, statics=statics,
+                                     config_hash=config_hash,
+                                     donation=donation)
+        return self._load(name, digest)
+
+    def _load(self, name: str, digest: str):
+        """Deserialize a committed entry; None on miss OR on any
+        defect (which also quarantines the entry) — the never-fails
+        half of the contract."""
+        bin_path, man_path = self._paths(digest)
+        if not man_path.exists():
+            return None
+        try:
+            meta = json.loads(retry(man_path.read_text,
+                                    policy=self._retry_policy,
+                                    describe=f"compile_cache manifest "
+                                             f"{digest[:12]}",
+                                    events=self._events))
+            self._check_meta(meta)
+            if not bin_path.exists():
+                raise _CacheEntryError("manifest without payload (torn "
+                                       "publish)")
+            data = retry(bin_path.read_bytes, policy=self._retry_policy,
+                         describe=f"compile_cache payload {digest[:12]}",
+                         events=self._events)
+            if len(data) != meta.get("size"):
+                raise _CacheEntryError(
+                    f"payload size {len(data)} != manifest "
+                    f"{meta.get('size')}")
+            if hashlib.sha256(data).hexdigest() != meta.get("sha256"):
+                raise _CacheEntryError("payload checksum mismatch")
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = pickle.loads(data)
+            compiled = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — the never-fails contract
+            self.quarantine(digest, reason=f"{type(e).__name__}: {e}")
+            return None
+        CACHE_STATS["hit"] += 1
+        self._event("hit", name=name, digest=digest[:12])
+        return compiled
+
+    def _check_meta(self, meta: dict) -> None:
+        """Belt-and-braces version/backend gate: the digest already
+        encodes all of this, so a mismatch here means the entry was
+        tampered with or the key scheme drifted — either way it must
+        not load."""
+        import jaxlib
+
+        fp = backend_fingerprint()
+        for field, want in (("jax", jax.__version__),
+                            ("jaxlib", jaxlib.__version__),
+                            ("platform", fp["platform"])):
+            have = meta.get(field)
+            if have != want:
+                raise _CacheEntryError(
+                    f"{field} mismatch: entry has {have!r}, runtime is "
+                    f"{want!r}")
+
+    # -- store ---------------------------------------------------------
+
+    def store(self, name: str, key: dict, digest: str, compiled) -> bool:
+        """Serialize + publish atomically. Payload first, manifest
+        (the commit point) second; both via unique-tmp + os.replace, so
+        racing replicas publishing the same digest both succeed. Never
+        raises — a backend that cannot serialize costs a telemetry
+        event, not the job."""
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            data = pickle.dumps((payload, in_tree, out_tree))
+        except Exception as e:  # noqa: BLE001 — the never-fails contract
+            CACHE_STATS["serialize_unsupported"] += 1
+            self._event("serialize_unsupported", name=name,
+                        digest=digest[:12],
+                        error=f"{type(e).__name__}: {e}"[:200])
+            return False
+        import jaxlib
+
+        bin_path, man_path = self._paths(digest)
+        meta = {
+            "name": name,
+            "digest": digest,
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "size": len(data),
+            "created": round(time.time(), 3),
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": key["backend"]["platform"],
+            "key": key,
+        }
+        # unique per WRITER, not per pid: two threads of one process
+        # (or pid-coinciding hosts on a shared filesystem) racing the
+        # same digest must never share a tmp path, or truncate-write-
+        # rename atomicity — the whole publish contract — is gone
+        import uuid
+
+        nonce = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        try:
+            tmp = bin_path.with_name(f"{bin_path.name}.tmp{nonce}")
+            tmp.write_bytes(data)
+            os.replace(tmp, bin_path)
+            tmp = man_path.with_name(f"{man_path.name}.tmp{nonce}")
+            tmp.write_text(json.dumps(meta, indent=0, sort_keys=True))
+            os.replace(tmp, man_path)
+        except OSError as e:
+            CACHE_STATS["store_failed"] += 1
+            self._event("store_failed", name=name, digest=digest[:12],
+                        error=f"{type(e).__name__}: {e}"[:200])
+            return False
+        CACHE_STATS["store"] += 1
+        self._event("store", name=name, digest=digest[:12],
+                    bytes=len(data))
+        return True
+
+    # -- the integration surface ---------------------------------------
+
+    def load_or_compile(self, name: str, compile_fn, example_args, *,
+                        statics: str = "", config_hash: str = "",
+                        donation: str = ""):
+        """Returns ``(jax.stages.Compiled, "hit" | "miss")``. A hit
+        deserializes (no trace, no XLA compile); a miss runs
+        ``compile_fn()`` — the caller's ``lower().compile()`` thunk,
+        whose errors propagate since the jit path would fail
+        identically — and publishes the result for the next process."""
+        key, digest = self.entry_key(name, example_args, statics=statics,
+                                     config_hash=config_hash,
+                                     donation=donation)
+        compiled = self._load(name, digest)
+        if compiled is not None:
+            return compiled, "hit"
+        CACHE_STATS["miss"] += 1
+        self._event("miss", name=name, digest=digest[:12])
+        compiled = compile_fn()
+        self.store(name, key, digest, compiled)
+        return compiled, "miss"
+
+    def note_exec_failure(self, name: str, error: BaseException) -> None:
+        """A deserialized executable failed at CALL time (e.g. a
+        sharding-committed argument the baked convention rejects): the
+        caller dropped it and fell back to jit — record why."""
+        CACHE_STATS["exec_failed"] += 1
+        self._event("exec_failed", name=name,
+                    error=f"{type(error).__name__}: {error}"[:200])
+
+    # -- quarantine / maintenance --------------------------------------
+
+    def quarantine(self, digest: str, *, reason: str = "") -> None:
+        """Move a defective entry out of the lookup path (evidence,
+        not garbage — same philosophy as checkpoint quarantine).
+        Race-tolerant: losing the os.replace to a sibling process is
+        success."""
+        qdir = self.directory / QUARANTINE_DIR
+        qdir.mkdir(exist_ok=True)
+        for path in self._paths(digest):
+            if not path.exists():
+                continue
+            dest = qdir / path.name
+            if dest.exists():
+                dest = qdir / f"{path.name}.{int(time.time() * 1e3)}"
+            try:
+                os.replace(path, dest)
+            except FileNotFoundError:
+                pass  # a sibling process quarantined it first
+        CACHE_STATS["quarantined"] += 1
+        self._event("quarantine", digest=digest[:12], reason=reason[:200])
+
+    def entries(self) -> list[dict]:
+        """Manifest metadata of every committed entry (newest first)."""
+        out = []
+        for man in sorted(self.directory.glob("*.json")):
+            try:
+                out.append(json.loads(man.read_text()))
+            except (OSError, ValueError):
+                continue  # torn manifest: verify/gc handle it
+        return sorted(out, key=lambda m: m.get("created", 0),
+                      reverse=True)
+
+    def verify(self) -> list[tuple[str, bool, str]]:
+        """Offline integrity sweep: (digest, ok, detail) per entry —
+        checksum and version checks only, nothing is loaded onto
+        devices and nothing is quarantined (the CLI reports; the load
+        path enforces)."""
+        out = []
+        seen = set()
+        for man in sorted(self.directory.glob("*.json")):
+            digest = man.stem
+            seen.add(digest)
+            bin_path = self.directory / f"{digest}.bin"
+            try:
+                meta = json.loads(man.read_text())
+                self._check_meta(meta)
+                data = bin_path.read_bytes()
+                if len(data) != meta.get("size"):
+                    raise _CacheEntryError("size mismatch")
+                if hashlib.sha256(data).hexdigest() != meta.get("sha256"):
+                    raise _CacheEntryError("checksum mismatch")
+            except Exception as e:  # noqa: BLE001 — report, don't raise
+                out.append((digest, False, f"{type(e).__name__}: {e}"))
+                continue
+            out.append((digest, True,
+                        f"{meta.get('name', '?')} {meta.get('size', 0)}B"))
+        for orphan in sorted(self.directory.glob("*.bin")):
+            if orphan.stem not in seen:
+                out.append((orphan.stem, False,
+                            "payload without manifest (torn publish)"))
+        return out
+
+    def gc(self, *, max_age_days: float | None = None,
+           keep: int | None = None) -> int:
+        """Delete entries older than ``max_age_days`` and/or beyond the
+        ``keep`` newest; payload-without-manifest orphans always go.
+        Returns the number of entries removed."""
+        removed = 0
+        entries = self.entries()
+        cutoff = (time.time() - max_age_days * 86400.0
+                  if max_age_days is not None else None)
+        for i, meta in enumerate(entries):
+            dead = ((cutoff is not None
+                     and meta.get("created", 0) < cutoff)
+                    or (keep is not None and i >= keep))
+            if not dead:
+                continue
+            for path in self._paths(meta["digest"]):
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+            removed += 1
+        manifests = {m.stem for m in self.directory.glob("*.json")}
+        for orphan in self.directory.glob("*.bin"):
+            if orphan.stem not in manifests:
+                try:
+                    orphan.unlink()
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    # -- internals -----------------------------------------------------
+
+    def _event(self, action: str, **data) -> None:
+        if self._events is not None:
+            self._events.emit(EVENT_COMPILE_CACHE, step=-1, action=action,
+                              **data)
+
+
+def stats_snapshot() -> dict:
+    """Plain-dict copy of CACHE_STATS (the tests/bench tripwire)."""
+    return dict(CACHE_STATS)
+
+
+# ---------------------------------------------------------------------
+# offline CLI
+
+
+def _cmd_ls(cache: CompileCache) -> int:
+    entries = cache.entries()
+    if not entries:
+        print(f"no entries under {cache.directory}")
+        return 0
+    print(f"{'digest':<14}{'name':<28}{'bytes':>12}  {'platform':<8}"
+          f"{'jax':<10}created")
+    for m in entries:
+        created = time.strftime("%Y-%m-%d %H:%M:%S",
+                                time.localtime(m.get("created", 0)))
+        print(f"{m.get('digest', '?')[:12]:<14}"
+              f"{m.get('name', '?')[:26]:<28}{m.get('size', 0):>12}  "
+              f"{m.get('platform', '?'):<8}{m.get('jax', '?'):<10}"
+              f"{created}")
+    print(f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}")
+    return 0
+
+
+def _cmd_verify(cache: CompileCache) -> int:
+    verdicts = cache.verify()
+    if not verdicts:
+        print(f"no entries under {cache.directory}")
+        return 0
+    bad = 0
+    for digest, ok, detail in verdicts:
+        print(f"{digest[:12]:<14}{'OK' if ok else 'CORRUPT':<9}{detail}")
+        bad += not ok
+    print(f"{len(verdicts)} entr{'y' if len(verdicts) == 1 else 'ies'}, "
+          f"{bad} bad")
+    return 1 if bad else 0
+
+
+def _cmd_prewarm(cache_dir: str, spec_json: str) -> int:
+    """Compile + serialize every program a replica-worker spec needs —
+    the deploy-time half of seconds-scale replica join. Reuses the
+    worker's own engine builder so prewarmed programs are exactly the
+    ones a live worker will ask for."""
+    spec = json.loads(spec_json)
+    spec.setdefault("engine", {})["compile_cache"] = cache_dir
+    # the canonical module's counters, NOT this file's globals: under
+    # ``python -m`` runpy executes a second copy of this file as
+    # __main__, while the engine increments the normally-imported one
+    from pytorchdistributed_tpu.runtime.compile_cache import (
+        stats_snapshot as canonical_stats,
+    )
+    from pytorchdistributed_tpu.serving.replica_worker import _build_engine
+
+    before = canonical_stats()
+    engine = _build_engine(spec)
+    engine.warmup(prompt_lens=spec.get("warmup_lens") or None)
+    engine.close()
+    after = canonical_stats()
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in set(after) | set(before)}
+    print(json.dumps({"prewarmed": delta.get("store", 0),
+                      "already_cached": delta.get("hit", 0),
+                      "serialize_unsupported":
+                          delta.get("serialize_unsupported", 0)}))
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "pytorchdistributed_tpu.runtime.compile_cache")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, doc in (("ls", "list committed entries"),
+                      ("verify", "integrity-check every entry"),
+                      ("gc", "delete old/excess entries")):
+        p = sub.add_parser(name, help=doc)
+        p.add_argument("directory")
+    sub.choices["gc"].add_argument("--max-age-days", type=float,
+                                   default=None)
+    sub.choices["gc"].add_argument("--keep", type=int, default=None)
+    pw = sub.add_parser(
+        "prewarm", help="compile + serialize every program a replica "
+                        "spec needs (deploy-time warm cache)")
+    pw.add_argument("directory")
+    pw.add_argument("--spec", required=True,
+                    help="replica_worker JSON spec (model/size/engine "
+                         "kwargs; optional warmup_lens)")
+    args = parser.parse_args(argv)
+    if args.cmd == "prewarm":
+        return _cmd_prewarm(args.directory, args.spec)
+    cache = CompileCache(args.directory, events=None)
+    if args.cmd == "ls":
+        return _cmd_ls(cache)
+    if args.cmd == "verify":
+        return _cmd_verify(cache)
+    removed = cache.gc(max_age_days=args.max_age_days, keep=args.keep)
+    print(f"removed {removed} entr{'y' if removed == 1 else 'ies'}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

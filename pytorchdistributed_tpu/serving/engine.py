@@ -79,6 +79,10 @@ from pytorchdistributed_tpu.inference import (
     sample_slots,
     stop_ids_tuple,
 )
+from pytorchdistributed_tpu.runtime.compile_cache import (
+    CompileCache,
+    static_repr,
+)
 from pytorchdistributed_tpu.serving.paging import (
     BlockAllocator,
     RadixPrefixCache,
@@ -534,6 +538,17 @@ class ServingEngine:
         self-drafts with the target model itself: acceptance ~1, the
         correctness/bring-up configuration.
       draft_params: the draft's variables (required with draft_config).
+      compile_cache: the persistent AOT executable cache (ISSUE 10,
+        runtime/compile_cache.py): a CompileCache, a directory path, or
+        the default "auto" (the PTD_COMPILE_CACHE env contract; off
+        when unset). With a cache attached, every compiled program —
+        tick/prefill/spec/probe — dispatches through an AOT executable
+        that is DESERIALIZED from disk on a hit and
+        lower().compile()'d + published on a miss, so a restarted or
+        respawned engine reaches its first token with zero XLA
+        compiles; warmup() collapses to one probe round per bucket.
+        The contract is never-fails: any cache defect quarantines the
+        entry and the engine falls back to the plain jit path.
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
@@ -544,7 +559,8 @@ class ServingEngine:
                  prefill_chunk: int | None = None,
                  prefix_cache: bool = True,
                  prefill_chunks_per_step: int = 1,
-                 spec_k: int = 0, draft_config=None, draft_params=None):
+                 spec_k: int = 0, draft_config=None, draft_params=None,
+                 compile_cache="auto"):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -669,6 +685,18 @@ class ServingEngine:
         if telemetry is None and telemetry_dir is not None:
             telemetry = ServingTelemetry(telemetry_dir)
         self.telemetry = telemetry
+        # AOT executable table (ISSUE 10): with a compile cache
+        # attached, every compiled-program call goes through _aot_call —
+        # a per-program jax.stages.Compiled either deserialized from the
+        # cache or lower().compile()'d once and published. Without one
+        # (the default when PTD_COMPILE_CACHE is unset) the engine calls
+        # the module-level jit wrappers exactly as before.
+        self._compile_cache = CompileCache.resolve(compile_cache)
+        self._exec: dict[str, object] = {}
+        self._aot_failed: set[str] = set()
+        #: name -> "hit" | "miss" per AOT-resolved program (tests and
+        #: the coldstart bench read this after warmup)
+        self.aot_outcomes: dict[str, str] = {}
         self.reset_stats()
 
     # ------------------------------------------------------------------
@@ -756,19 +784,22 @@ class ServingEngine:
             with self._span("serve/decode_tick"), self._mesh_ctx():
                 # one shared per-slot argument tail; the paged tick just
                 # prepends the host-stamped block tables and lengths
-                tick, head = ((paged_decode_tick,
-                               (jnp.asarray(self._tables),
-                                jnp.asarray(self._lengths)))
-                              if self.paged else (decode_tick, ()))
-                self._cache, nxt = tick(
-                    self._tick_model, self._weights, self._cache, *head,
-                    jnp.asarray(self._tokens),
-                    jnp.asarray(self._key_data),
-                    jnp.asarray(self._counts),
-                    jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ks),
-                    jnp.asarray(self._top_ps),
-                    candidates=self.candidates)
+                name, tick, head = (("paged_decode_tick",
+                                     paged_decode_tick,
+                                     (jnp.asarray(self._tables),
+                                      jnp.asarray(self._lengths)))
+                                    if self.paged
+                                    else ("decode_tick", decode_tick, ()))
+                self._cache, nxt = self._aot_call(
+                    name, tick, (self._tick_model,),
+                    (self._weights, self._cache, *head,
+                     jnp.asarray(self._tokens),
+                     jnp.asarray(self._key_data),
+                     jnp.asarray(self._counts),
+                     jnp.asarray(self._temps),
+                     jnp.asarray(self._top_ks),
+                     jnp.asarray(self._top_ps)),
+                    dict(candidates=self.candidates))
                 toks = np.asarray(nxt)  # host sync: streaming delivery
             dt = time.perf_counter() - t0
             self._counts += 1
@@ -811,15 +842,18 @@ class ServingEngine:
         st = self._stats
         t0 = time.perf_counter()
         with self._span("serve/spec_tick"), self._mesh_ctx():
-            (self._cache, self._draft_cache, out, nacc) = spec_decode_tick(
-                self._tick_model, self._draft_tick_model, self._weights,
-                self._draft_weights, self._cache, self._draft_cache,
-                jnp.asarray(self._tables), jnp.asarray(self._lengths),
-                jnp.asarray(self._tokens), jnp.asarray(self._key_data),
-                jnp.asarray(self._counts),
-                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps),
-                spec_k=self.spec_k, candidates=self.candidates)
+            (self._cache, self._draft_cache, out, nacc) = self._aot_call(
+                "spec_decode_tick", spec_decode_tick,
+                (self._tick_model, self._draft_tick_model),
+                (self._weights, self._draft_weights, self._cache,
+                 self._draft_cache,
+                 jnp.asarray(self._tables), jnp.asarray(self._lengths),
+                 jnp.asarray(self._tokens), jnp.asarray(self._key_data),
+                 jnp.asarray(self._counts),
+                 jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                 jnp.asarray(self._top_ps)),
+                dict(spec_k=self.spec_k, candidates=self.candidates),
+                donation="cache,draft_cache")
             toks = np.asarray(out)   # host sync: streaming delivery
             ns = np.asarray(nacc)
         dt = time.perf_counter() - t0
@@ -974,25 +1008,28 @@ class ServingEngine:
                 jax.random.key(req.sampling.seed))))
         return True
 
-    def _chunk_call(self, model, weights, cache, pf, pos):
+    def _chunk_call(self, name, model, weights, cache, pf, pos):
         """One paged_prefill_chunk call for the admission in flight, at
         absolute position ``pos`` of its token stream — shared by the
-        target and (spec mode) draft cache fills."""
+        target and (spec mode) draft cache fills, which are distinct
+        AOT programs (``name`` keys the executable table: same shapes,
+        different static model)."""
         req = pf["req"]
         chunk = np.zeros((1, self.chunk), np.int32)
         n = min(self.chunk, pf["true_len"] - pos)
         chunk[0, :n] = pf["tokens"][pos:pos + n]
-        return paged_prefill_chunk(
-            model, weights, cache,
-            jnp.asarray(chunk), jnp.int32(pos),
-            jnp.asarray(pf["table_row"]),
-            jnp.int32(pf["true_len"]),
-            jnp.asarray(pf["kd"]),
-            jnp.int32(pf["resume"]),
-            jnp.float32(req.sampling.temperature),
-            jnp.int32(req.sampling.top_k),
-            jnp.float32(req.sampling.top_p),
-            candidates=self.candidates)
+        return self._aot_call(
+            name, paged_prefill_chunk, (model,),
+            (weights, cache,
+             jnp.asarray(chunk), jnp.int32(pos),
+             jnp.asarray(pf["table_row"]),
+             jnp.int32(pf["true_len"]),
+             jnp.asarray(pf["kd"]),
+             jnp.int32(pf["resume"]),
+             jnp.float32(req.sampling.temperature),
+             jnp.int32(req.sampling.top_k),
+             jnp.float32(req.sampling.top_p)),
+            dict(candidates=self.candidates))
 
     def _prefill_chunk_step(self) -> int:
         """Run ONE chunk step of the in-flight admission — a target
@@ -1010,15 +1047,16 @@ class ServingEngine:
                 pos = pf["pos"]
                 final_t = pos + self.chunk >= pf["true_len"]
                 self._cache, first = self._chunk_call(
-                    self._chunk_model, self._weights, self._cache, pf, pos)
+                    "paged_prefill_chunk", self._chunk_model,
+                    self._weights, self._cache, pf, pos)
                 if final_t:
                     # sync: the TTFT timestamp is honest
                     pf["first"] = int(first)
                 pf["pos"] = pos + self.chunk
             if self.spec_k and pf["dpos"] < pf["true_len"]:
                 self._draft_cache, _ = self._chunk_call(
-                    self._draft_chunk_model, self._draft_weights,
-                    self._draft_cache, pf, pf["dpos"])
+                    "paged_prefill_chunk_draft", self._draft_chunk_model,
+                    self._draft_weights, self._draft_cache, pf, pf["dpos"])
                 pf["dpos"] += self.chunk
         now = time.perf_counter()
         self._progress += 1
@@ -1167,17 +1205,40 @@ class ServingEngine:
         jitted programs' _cache_size are the tests' tripwires) and the
         first real TTFT pays no compile.
 
-        TWO serial rounds per bucket on purpose: the engine's fresh cache
-        is an uncommitted array, so round one compiles each program
-        against it, and jit then recompiles — without retracing — when
-        the cache next arrives committed from another executable's
-        output. Round two runs every program with exactly the
-        steady-state (committed) input shardings."""
+        TWO serial rounds per bucket on purpose (plain jit path): the
+        engine's fresh cache is an uncommitted array, so round one
+        compiles each program against it, and jit then recompiles —
+        without retracing — when the cache next arrives committed from
+        another executable's output. Round two runs every program with
+        exactly the steady-state (committed) input shardings.
+
+        With a compile cache attached (ISSUE 10), ONE round suffices:
+        every program dispatches through an AOT executable whose input
+        convention was fixed at lower time, so the fresh-vs-committed
+        recompile the second round exists to absorb cannot happen — a
+        cache hit makes the round a pure deserialized-executable probe
+        (zero traces, zero XLA compiles), a miss compiles each program
+        exactly once and publishes it. Either way the TTFT EMA is still
+        reset below: warmup TTFTs (deserialize or compile) must never
+        skew the router's balancer."""
         lens = tuple(prompt_lens) if prompt_lens else (self.bucket,)
-        for n in lens + lens:
+        rounds = 1 if self._compile_cache is not None else 2
+        for n in lens * rounds:
             n = max(1, min(n, self.cfg.max_seq_len - max_new_tokens))
             self.submit(np.zeros(n, np.int32), max_new_tokens=max_new_tokens)
             self.run_until_idle()
+        if rounds == 1 and self._aot_failed:
+            # a program fell back to jit during the single cached round
+            # (cache defect / unserializable backend): give the jit
+            # path its second round too, or the first real request
+            # would pay the fresh-vs-committed recompile on the hot
+            # path — the never-fails contract covers warmup's
+            # no-first-TTFT-compile promise as well
+            for n in lens:
+                n = max(1, min(n, self.cfg.max_seq_len - max_new_tokens))
+                self.submit(np.zeros(n, np.int32),
+                            max_new_tokens=max_new_tokens)
+                self.run_until_idle()
         # warm the health probe too: a router polling
         # check_params_finite() must find it compiled, or the first
         # steady-state health check pays a trace
@@ -1264,6 +1325,69 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # internals
 
+    def _aot_call(self, name, jit_fn, statics, args, kw_statics, *,
+                  donation="cache"):
+        """Dispatch one compiled-program call. With a compile cache:
+        resolve ``name`` to an AOT ``jax.stages.Compiled`` (deserialize
+        on a cache hit — no trace, no XLA compile; ``lower().compile()``
+        + publish on a miss) and call it with the DYNAMIC args only
+        (statics are baked into the executable). The AOT convention is
+        fixed at lower time, so the fresh-vs-committed-cache recompile
+        jit performs (the reason warmup ran two rounds) cannot happen
+        here. Any failure — cache defect, a backend that cannot
+        serialize, an executable rejecting a call — permanently falls
+        this program back to the plain jit path: the cache may only
+        ever make startup faster, never serving wrong or dead. Callers
+        invoke this inside their ``_mesh_ctx()``, so lowering sees the
+        same ambient mesh the jit path traces under."""
+        ex = self._exec.get(name)
+        if (ex is None and self._compile_cache is not None
+                and name not in self._aot_failed):
+            ex = self._aot_load_or_compile(name, jit_fn, statics, args,
+                                           kw_statics, donation)
+        if ex is not None:
+            try:
+                return ex(*args)
+            except Exception as e:  # noqa: BLE001 — never-fails contract
+                self._exec.pop(name, None)
+                self._aot_failed.add(name)
+                if self._compile_cache is not None:
+                    self._compile_cache.note_exec_failure(name, e)
+                # signature/sharding rejections raise BEFORE execution,
+                # leaving the donated buffers intact for the jit retry;
+                # a mid-execution failure (runtime error, OOM) has
+                # already consumed them — re-raise the REAL error
+                # rather than letting the retry mask it with a bogus
+                # "Array has been deleted"
+                if any(getattr(a, "is_deleted", lambda: False)()
+                       for a in jax.tree_util.tree_leaves(args)):
+                    raise
+        return jit_fn(*statics, *args, **kw_statics)
+
+    def _aot_load_or_compile(self, name, jit_fn, statics, args,
+                             kw_statics, donation):
+        srepr = ";".join(
+            [static_repr(s) for s in statics]
+            + [f"{k}={v!r}" for k, v in sorted(kw_statics.items())])
+        cfg_hash = (f"slots={self.num_slots};bucket={self.bucket};"
+                    f"block={self.block_size};blocks={self.num_blocks};"
+                    f"spec_k={self.spec_k}")
+
+        def compile_fn():
+            return jit_fn.lower(*statics, *args, **kw_statics).compile()
+
+        try:
+            compiled, outcome = self._compile_cache.load_or_compile(
+                name, compile_fn, args, statics=srepr,
+                config_hash=cfg_hash, donation=donation)
+        except Exception as e:  # noqa: BLE001 — never-fails contract
+            self._aot_failed.add(name)
+            self._compile_cache.note_exec_failure(name, e)
+            return None
+        self._exec[name] = compiled
+        self.aot_outcomes[name] = outcome
+        return compiled
+
     def _mesh_ctx(self):
         return (jax.set_mesh(self.mesh) if self.mesh is not None
                 else contextlib.nullcontext())
@@ -1291,14 +1415,18 @@ class ServingEngine:
             jax.random.key(req.sampling.seed)))
         t0 = time.perf_counter()
         with self._span("serve/prefill"), self._mesh_ctx():
-            self._cache, first = prefill_into_slot(
-                self._prefill_model, self._weights, self._cache,
-                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
-                jnp.asarray(kd), jnp.int32(resume),
-                jnp.float32(req.sampling.temperature),
-                jnp.int32(req.sampling.top_k),
-                jnp.float32(req.sampling.top_p),
-                candidates=self.candidates)
+            # one AOT program per prefill bucket length, same as the
+            # one-jit-signature-per-bucket the plain path compiles
+            self._cache, first = self._aot_call(
+                f"prefill_b{padded_len}", prefill_into_slot,
+                (self._prefill_model,),
+                (self._weights, self._cache,
+                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                 jnp.asarray(kd), jnp.int32(resume),
+                 jnp.float32(req.sampling.temperature),
+                 jnp.int32(req.sampling.top_k),
+                 jnp.float32(req.sampling.top_p)),
+                dict(candidates=self.candidates))
             first = int(first)  # sync: the TTFT timestamp is honest
         now = time.perf_counter()
         self._progress += 1
@@ -1396,7 +1524,8 @@ class ServingEngine:
         replica's weights carry NaN/Inf — every token it emits is
         garbage and a router must quarantine it."""
         with self._mesh_ctx():
-            ok = bool(params_finite(self._weights))
+            ok = bool(self._aot_call("params_finite", params_finite, (),
+                                     (self._weights,), {}, donation=""))
         self._sick = not ok
         return ok
 

@@ -30,10 +30,18 @@ the router's probe op must come back non-finite.
 
 The spec: {"model": "gpt2"|"llama", "size": "test", "overrides": {...
 TransformerConfig overrides}, "init_seed": 1, "engine": {...
-ServingEngine kwargs}, "max_seq_len": ...}. Params are INITIALIZED from
-init_seed — deterministic across replicas without shipping weights over
-a pipe; a real deployment points "checkpoint" at a restore path
-instead (TODO alongside the ROADMAP 5 AOT cache).
+ServingEngine kwargs}, "max_seq_len": ..., "checkpoint": <dir>,
+"checkpoint_step": <int>, "compile_cache": <dir>}. Params come from
+``"checkpoint"`` when set — training/checkpoint.py's VERIFIED
+params-only restore (manifest-checked, corrupt steps quarantined and
+walked past), falling back to ``init_seed`` with a logged
+TelemetryEvent when the checkpoint is absent or unusable (a worker
+that cannot load weights must still join the fleet deterministically,
+not die in a respawn loop). ``"compile_cache"`` points the engine at
+the persistent AOT executable cache (runtime/compile_cache.py; the
+PTD_COMPILE_CACHE env works too) — together they are what makes a
+router-respawned replica serve again in load-bound seconds instead of
+compile-bound minutes (ISSUE 10).
 """
 
 from __future__ import annotations
@@ -44,10 +52,76 @@ import signal
 import sys
 
 
-def _build_engine(spec: dict):
+def _load_params(spec: dict, model):
+    """The worker's weights: a verified checkpoint restore when the
+    spec names one (TelemetryEvent either way), else deterministic
+    seed-init — replicas agree on params without shipping weights over
+    a pipe."""
     import jax
     import jax.numpy as jnp
 
+    from pytorchdistributed_tpu.telemetry.events import (
+        EVENT_REPLICA_RESTORE,
+        EVENT_REPLICA_RESTORE_FALLBACK,
+        EventLog,
+    )
+
+    events = EventLog.from_env(int(os.environ.get("RANK", "0")))
+    ckpt = spec.get("checkpoint")
+    if ckpt:
+        try:
+            from pytorchdistributed_tpu.training.checkpoint import (
+                CheckpointManager,
+            )
+
+            mgr = CheckpointManager(ckpt)
+            try:
+                params, step = mgr.restore_params(
+                    step=spec.get("checkpoint_step"))
+            finally:
+                mgr.close()
+            # Restored-as-saved trees carry orbax's rendering of flax
+            # metadata nodes (nn.Partitioned boxes become plain dicts),
+            # so re-shape the leaves onto the MODEL's own abstract
+            # params structure — leaf order is stable (both are DFS
+            # over the same module-path dicts; a metadata box is a
+            # singleton wrapper) and the shape check below turns any
+            # genuine mismatch (wrong model for this checkpoint) into
+            # the seed-init fallback instead of a garbled apply. Also
+            # re-commits host-numpy leaves to device arrays once.
+            import flax.linen as nn
+
+            abstract = nn.meta.unbox(jax.eval_shape(
+                lambda: model.init(jax.random.key(0),
+                                   jnp.zeros((1, 8), jnp.int32))))
+            treedef = jax.tree_util.tree_structure(abstract)
+            leaves = jax.tree_util.tree_leaves(params)
+            want = jax.tree_util.tree_leaves(abstract)
+            if len(leaves) != len(want):
+                raise ValueError(
+                    f"checkpoint has {len(leaves)} param leaves, model "
+                    f"expects {len(want)}")
+            for have, sds in zip(leaves, want):
+                if tuple(have.shape) != tuple(sds.shape):
+                    raise ValueError(
+                        f"checkpoint leaf shape {tuple(have.shape)} != "
+                        f"model's {tuple(sds.shape)}")
+            if events is not None:
+                events.emit(EVENT_REPLICA_RESTORE, step=step,
+                            checkpoint=str(ckpt))
+            return jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(x) for x in leaves])
+        except Exception as e:  # noqa: BLE001 — worker must still join
+            if events is not None:
+                events.emit(EVENT_REPLICA_RESTORE_FALLBACK, step=-1,
+                            checkpoint=str(ckpt),
+                            error=f"{type(e).__name__}: {e}"[:200])
+    return jax.jit(model.init)(
+        jax.random.key(int(spec.get("init_seed", 0))),
+        jnp.zeros((1, 8), jnp.int32))
+
+
+def _build_engine(spec: dict):
     from pytorchdistributed_tpu.models import (
         GPT2,
         Llama,
@@ -66,12 +140,13 @@ def _build_engine(spec: dict):
     else:
         cfg = gpt2_config(size, **overrides)
         model = GPT2(cfg)
-    params = jax.jit(model.init)(
-        jax.random.key(int(spec.get("init_seed", 0))),
-        jnp.zeros((1, 8), jnp.int32))
+    params = _load_params(spec, model)
     telemetry = ServingTelemetry.from_env()
+    engine_kwargs = dict(spec.get("engine", {}))
+    if spec.get("compile_cache"):
+        engine_kwargs.setdefault("compile_cache", spec["compile_cache"])
     return ServingEngine(model, params, telemetry=telemetry,
-                         **spec.get("engine", {}))
+                         **engine_kwargs)
 
 
 def main() -> int:

@@ -39,7 +39,16 @@ in PR 4). This module is the SERVING restatement of that contract:
     router queue → immediate ``finish_reason="shed"`` instead of
     unbounded latency), replica **quarantine/rejoin** with a warmup
     canary re-admission, and router-level graceful **drain on SIGTERM**
-    (finish resident streams, shed the queue, leave no orphan replica).
+    (finish resident streams, shed the queue, leave no orphan replica);
+  * and since ISSUE 10, **auto-respawn**: a DEAD replica is RELAUNCHED
+    (``respawn_budget`` attempts with exponential backoff) — subprocess
+    workers restart under the same env/spec contract, restoring weights
+    from a verified checkpoint and their executables from the
+    persistent AOT compile cache (runtime/compile_cache.py), so the
+    relaunch is load-bound seconds, not compile-bound minutes — and
+    rejoins through the same quarantine → clean-probe → canary gauntlet
+    as a NaN recovery. A crash is a transient, not a permanent capacity
+    loss; torchrun's elastic agent, restated for serving.
 
 Chaos is first-class: ``faults/inject.py`` grew ``replica_crash`` /
 ``replica_hang`` / ``replica_nan`` serving faults (``PTD_FAULTS`` /
@@ -83,6 +92,19 @@ HEALTHY, QUARANTINED, DEAD = "healthy", "quarantined", "dead"
 #: so a flapping replica set cannot melt the router in a redispatch storm.
 ROUTER_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.005,
                            backoff=2.0, max_delay_s=0.25, jitter=0.25)
+
+#: Default respawn backoff (ISSUE 10): a DEAD replica's relaunch
+#: attempts space out exponentially — a crash-looping worker (bad
+#: checkpoint, poisoned cache entry, broken node) must burn its budget
+#: slowly instead of melting the router in a spawn storm. Slower than
+#: ROUTER_RETRY on purpose: a respawn pays process start + restore +
+#: (cached) warmup, not a redispatch.
+RESPAWN_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.05,
+                            backoff=2.0, max_delay_s=5.0, jitter=0.25)
+
+#: env default for ``respawn_budget`` (relaunches per replica; 0 = the
+#: pre-ISSUE-10 behavior where DEAD is forever)
+ROUTER_RESPAWN_ENV = "PTD_ROUTER_RESPAWN"
 
 
 class ReplicaCrashed(RuntimeError):
@@ -371,6 +393,17 @@ class SubprocessReplica:
         # reply carries the engine's real max_seq_len
         self._consume(self.wait_response(timeout=600.0))
 
+    def warmup_async(self, prompt_lens=None) -> None:
+        """Send the warmup op WITHOUT waiting — the respawn path
+        (ISSUE 10): a replacement worker's startup (jax import +
+        checkpoint restore + cached warmup) must not stall the router's
+        tick loop. While ``_warming``, probe() reports un-ready, so the
+        quarantine machine keeps the replica parked; the warmup reply
+        is consumed by the probe path's receive whenever it lands."""
+        self._warming = True
+        self._send({"op": "warmup",
+                    "prompt_lens": list(prompt_lens or [])})
+
     def submit(self, rr: RouterRequest, *, generated, deadline_s,
                on_token):
         self._drain_wire()
@@ -419,6 +452,7 @@ class SubprocessReplica:
             return
         if "max_seq_len" in resp:
             self.reported_max_seq_len = int(resp["max_seq_len"])
+            self._warming = False  # the async-warmup reply landed
         if resp.get("health"):
             self._health = resp["health"]
             self._health["alive"] = True
@@ -473,6 +507,11 @@ class SubprocessReplica:
         resp = self._try_recv()
         if resp is not None:
             self._consume(resp)
+        if getattr(self, "_warming", False):
+            # async-respawn startup in flight: not ready is the honest
+            # verdict (the optimistic True below would let the rejoin
+            # streak run out before the worker can even serve)
+            return False
         if (self._pending_op is None
                 and (exclusive
                      or getattr(self, "_last_sent", None) != "probe")):
@@ -549,6 +588,25 @@ class ReplicaRouter:
         tick's device dispatches for tiny models).
       rejoin_after: consecutive CLEAN probes a quarantined replica
         needs before the warmup canary + re-admission.
+      respawn_budget: relaunches each DEAD replica may consume
+        (ISSUE 10; default the PTD_ROUTER_RESPAWN env, else 0 = DEAD
+        is forever). A crashed/hung replica is rebuilt — subprocess
+        workers relaunch under the same spec/env contract (a
+        ``"checkpoint"`` + ``"compile_cache"`` spec makes that a
+        load-bound-seconds restart), in-process replicas re-run their
+        engine factory — then rejoins through the EXISTING
+        quarantine → clean-probe → canary path, so a recovered
+        replica proves itself before real traffic returns. Its
+        former streams were already failed over; respawn restores
+        CAPACITY, turning a crash into a transient instead of a
+        permanent fleet shrink.
+      respawn_policy: faults/retry.py backoff between one replica's
+        relaunch attempts (default RESPAWN_RETRY: exponential,
+        jittered, capped at seconds).
+      respawn_warmup_s: startup bound for a respawned subprocess
+        worker's ASYNC warmup — past it the replacement is declared
+        hung and the next budgeted attempt proceeds (mirrors the
+        synchronous warmup()'s 600 s response timeout).
       faults: a FaultInjector, None to disable chaos entirely, or
         "auto" (default: the process-global ``faults.active()`` —
         the PTD_FAULTS contract).
@@ -565,20 +623,29 @@ class ReplicaRouter:
                  retry_policy: RetryPolicy = ROUTER_RETRY,
                  hang_ticks: int = 8, health_every: int = 4,
                  rejoin_after: int = 3, max_pending: int = 1,
+                 respawn_budget: int | None = None,
+                 respawn_policy: RetryPolicy = RESPAWN_RETRY,
+                 respawn_warmup_s: float = 600.0,
                  faults="auto", telemetry: RouterTelemetry | None = None,
                  telemetry_dir=None, sample_every: int = 1,
                  seed: int = 0):
         self.warmup_lens = tuple(warmup_lens) if warmup_lens else None
         self._hb_dir = None
+        self._worker_specs = None
+        self._worker_port = None
         if workers is not None:
             import tempfile
 
             from pytorchdistributed_tpu.run import free_port
 
             # one liveness dir + ONE master port for the worker fleet
-            # (the run.py group env contract); dir removed at close()
+            # (the run.py group env contract); dir removed at close().
+            # spec list + port kept: respawn relaunches a DEAD worker
+            # under the exact same contract
             self._hb_dir = tempfile.mkdtemp(prefix="ptd_router_hb_")
             port = free_port()
+            self._worker_specs = list(workers)
+            self._worker_port = port
             self._replicas = [
                 SubprocessReplica(i, spec, world_size=len(workers),
                                   heartbeat_dir=self._hb_dir,
@@ -631,6 +698,14 @@ class ReplicaRouter:
         self.health_every = max(1, health_every)
         self.rejoin_after = max(1, rejoin_after)
         self.max_pending = max(0, max_pending)
+        if respawn_budget is None:
+            respawn_budget = int(os.environ.get(ROUTER_RESPAWN_ENV, "0"))
+        self.respawn_budget = max(0, respawn_budget)
+        self.respawn_policy = respawn_policy
+        self.respawn_warmup_s = respawn_warmup_s
+        self._respawns = [0 for _ in self._replicas]
+        self._respawn_eligible = [0.0 for _ in self._replicas]
+        self._warming_deadline = [0.0 for _ in self._replicas]
         # "auto" = the process-global PTD_FAULTS contract; None = chaos
         # explicitly off (bench baseline legs); or a FaultInjector
         self._faults = (faults_inject.active() if faults == "auto"
@@ -747,6 +822,9 @@ class ReplicaRouter:
                         r.apply_fault(kind)
         # 2. health + watchdog + quarantine machine
         self._check_health()
+        # 2b. respawn DEAD replicas with budget left (ISSUE 10) —
+        # recovered capacity rejoins through the quarantine machine
+        self._maybe_respawn()
         # 3. dispatch
         dispatched = self._dispatch()
         # 4. step replicas
@@ -825,6 +903,16 @@ class ReplicaRouter:
                     if not ok:
                         self._quarantine(r)
             elif self._status[i] == QUARANTINED:
+                # a respawned worker still WARMING past its startup
+                # bound is wedged (bad node, poisoned restore): the
+                # sync warmup() path had wait_response(600) — the async
+                # path must enforce the same bound, or the slot parks
+                # forever with respawn budget unspent
+                if (getattr(r, "_warming", False)
+                        and 0 < self._warming_deadline[i]
+                        < time.perf_counter()):
+                    self._declare_dead(r, "hung")
+                    continue
                 try:
                     ok = r.probe(exclusive=True)
                 except ReplicaCrashed:
@@ -842,9 +930,130 @@ class ReplicaRouter:
         self._stats["replicas_lost"] += 1
         if why == "hung":
             self._stats["hangs_detected"] += 1
+        if self.respawn_budget:
+            # arm the respawn gate: attempt k waits the policy's k-th
+            # exponential delay, so a crash-looping replica burns its
+            # budget slowly instead of spawn-storming
+            self._respawn_eligible[r.index] = (
+                time.perf_counter()
+                + self.respawn_policy.delay(1 + self._respawns[r.index],
+                                            self._rng))
         self._event("replica_dead", replica=r.index, why=why,
                     stale_ticks=self._stale[r.index])
         self._failover(r, why)
+
+    # -- respawn (ISSUE 10) --------------------------------------------
+
+    def _maybe_respawn(self) -> None:
+        """Relaunch DEAD replicas that still have respawn budget and
+        whose backoff gate has opened. A fresh replica enters
+        QUARANTINED, not HEALTHY: it must earn its way back through the
+        same clean-probe streak + warmup canary a NaN-recovered replica
+        does — a respawn that comes up broken (corrupt checkpoint, bad
+        node) costs probes, never traffic."""
+        if not self.respawn_budget or self._draining:
+            return
+        now = time.perf_counter()
+        for i, r in enumerate(self._replicas):
+            if (self._status[i] != DEAD
+                    or self._respawns[i] >= self.respawn_budget
+                    or now < self._respawn_eligible[i]):
+                continue
+            self._respawns[i] += 1
+            attempt = self._respawns[i]
+            # arm the NEXT attempt's gate up front — a failed spawn
+            # below must not retry on the very next tick
+            self._respawn_eligible[i] = (
+                now + self.respawn_policy.delay(1 + attempt, self._rng))
+            self._dispose_corpse(r)
+            fresh = None
+            try:
+                fresh = self._build_replacement(r)
+                if isinstance(fresh, SubprocessReplica):
+                    # NON-blocking: the replacement's startup (jax
+                    # import + restore + warmup) runs while the router
+                    # keeps ticking the healthy replicas; probe()
+                    # reports un-ready until the warmup reply lands,
+                    # so the quarantine machine holds it parked —
+                    # bounded by respawn_warmup_s (checked in
+                    # _check_health), or a wedged startup would park
+                    # the slot forever
+                    fresh.warmup_async(self.warmup_lens)
+                    self._warming_deadline[i] = (
+                        time.perf_counter() + self.respawn_warmup_s)
+                else:
+                    # in-process engines share the router's thread by
+                    # construction; their warmup is the (cached) fast
+                    # path and cannot be deferred off-thread
+                    fresh.warmup(self.warmup_lens)
+            except Exception as e:  # noqa: BLE001 — spawn is best-effort
+                if fresh is not None:
+                    try:  # a half-spawned worker must not linger
+                        fresh.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._stats["respawn_failures"] += 1
+                self._event("respawn_failed", replica=i, attempt=attempt,
+                            error=f"{type(e).__name__}: {e}"[:200])
+                if attempt >= self.respawn_budget:
+                    self._event("respawn_exhausted", replica=i,
+                                attempts=attempt)
+                continue
+            self._replicas[i] = fresh
+            self._status[i] = QUARANTINED
+            self._clean_probes[i] = 0
+            self._stale[i] = 0
+            self._last_progress[i] = None
+            self._last_progress_t[i] = time.perf_counter()
+            try:
+                self._health[i] = fresh.health()
+            except ReplicaCrashed:
+                self._declare_dead(fresh, "crashed")
+                continue
+            self._stats["respawns"] += 1
+            self._event("respawn", replica=i, attempt=attempt)
+
+    def _dispose_corpse(self, r) -> None:
+        """Tear down a DEAD replica without the graceful-close protocol
+        (it is dead — there is nobody to drain) and with a SHORT
+        kill_group grace, so reclaiming a wedged corpse costs the tick
+        loop ~a second, not the full shutdown escalation."""
+        try:
+            if isinstance(r, SubprocessReplica):
+                from pytorchdistributed_tpu.run import kill_group
+
+                kill_group([r.proc], grace=1.0)
+                r.alive = False
+                for pipe in (r.proc.stdin, r.proc.stdout):
+                    try:
+                        pipe.close()
+                    except OSError:
+                        pass
+            else:
+                r.close()
+        except Exception:  # noqa: BLE001 — the corpse can't block us
+            pass
+
+    def _build_replacement(self, r):
+        if isinstance(r, SubprocessReplica):
+            return SubprocessReplica(
+                r.index, self._worker_specs[r.index],
+                world_size=len(self._replicas),
+                heartbeat_dir=self._hb_dir,
+                master_port=self._worker_port)
+        if isinstance(r, InProcessReplica):
+            return InProcessReplica(r.index, r._factory,
+                                    warmup_lens=r.warmup_lens)
+        raise TypeError(f"cannot respawn replica type {type(r).__name__}")
+
+    def _fleet_unrecoverable(self) -> bool:
+        """All replicas DEAD *and* no respawn can ever bring one back —
+        the only state where waiting on the router is hopeless."""
+        if any(s != DEAD for s in self._status):
+            return False
+        if not self.respawn_budget:
+            return True
+        return all(n >= self.respawn_budget for n in self._respawns)
 
     def _quarantine(self, r) -> None:
         """Sick (params non-finite): fail its streams over NOW — every
@@ -1125,9 +1334,10 @@ class ReplicaRouter:
         while self._queue or any(self._assigned[r.index]
                                  for r in self._replicas):
             # quarantined replicas still count: the rejoin probes that
-            # could restore them only run inside step() — only an
-            # all-DEAD fleet is genuinely unrecoverable
-            if all(s == DEAD for s in self._status):
+            # could restore them only run inside step(), and so do
+            # respawns — only an all-DEAD fleet with no respawn budget
+            # left is genuinely unrecoverable
+            if self._fleet_unrecoverable():
                 raise RuntimeError(
                     "every replica is dead with work outstanding")
             if max_steps <= 0:
@@ -1146,7 +1356,7 @@ class ReplicaRouter:
                 sent += 1
             if rr.done:
                 return
-            if all(s == DEAD for s in self._status):
+            if self._fleet_unrecoverable():
                 raise RuntimeError(
                     "every replica is dead; the stream cannot finish")
             self.step()
@@ -1254,6 +1464,7 @@ class ReplicaRouter:
                            failed_requests=0, failovers=0,
                            redispatched_requests=0, quarantines=0,
                            rejoins=0, hangs_detected=0, replicas_lost=0,
+                           respawns=0, respawn_failures=0,
                            served_by={}, ttft_s=[],
                            failover_recovery_ticks=[],
                            failover_recovery_s=[])
@@ -1302,6 +1513,8 @@ class ReplicaRouter:
             "rejoins": st["rejoins"],
             "hangs_detected": st["hangs_detected"],
             "replicas_lost": st["replicas_lost"],
+            "respawns": st["respawns"],
+            "respawn_failures": st["respawn_failures"],
             "served_by": dict(sorted(st["served_by"].items())),
             "replica_occupancy": occ,
             "occupancy_spread": (round(max(known) - min(known), 4)

@@ -287,7 +287,8 @@ def _router_section(run_dir: str) -> list[str]:
                 + f"  failovers {summary.get('failovers', 0)}  "
                 f"redispatched {summary.get('redispatched_requests', 0)}  "
                 f"quarantines {summary.get('quarantines', 0)}  "
-                f"rejoins {summary.get('rejoins', 0)}"
+                f"rejoins {summary.get('rejoins', 0)}  "
+                f"respawns {summary.get('respawns', 0)}"
                 + (f"  recovery {rec} ticks" if rec is not None else ""))
         n_replicas = (summary.get("replicas") if summary
                       else 1 + max((s.get("replica", 0)
@@ -297,7 +298,8 @@ def _router_section(run_dir: str) -> list[str]:
                   ((summary or {}).get("served_by") or {}).items()}
         lines.append(f"  {'replica':>7}  {'status':>11}  {'served':>6}  "
                      f"{'occupancy':>9}  {'failovers':>9}  "
-                     f"{'quarantines':>11}  {'rejoins':>7}")
+                     f"{'quarantines':>11}  {'rejoins':>7}  "
+                     f"{'respawns':>8}")
         for i in range(n_replicas or 0):
             status = next((s.get("status", "-") for s in reversed(samples)
                            if s.get("replica") == i), "-")
@@ -310,11 +312,14 @@ def _router_section(run_dir: str) -> list[str]:
             rej = sum(1 for e in events
                       if e.get("event") == "rejoin"
                       and e.get("replica") == i)
+            resp = sum(1 for e in events
+                       if e.get("event") == "respawn"
+                       and e.get("replica") == i)
             o = occ[i] if i < len(occ) and occ[i] is not None else None
             lines.append(
                 f"  {i:>7}  {status:>11}  {served.get(i, 0):>6}  "
                 f"{(f'{o:.2%}' if o is not None else '-'):>9}  "
-                f"{lost:>9}  {quar:>11}  {rej:>7}")
+                f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}")
     return lines
 
 

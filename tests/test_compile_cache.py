@@ -1,0 +1,689 @@
+"""Elastic recovery suite: the persistent AOT executable cache
+(runtime/compile_cache.py, ISSUE 10) and its integrations.
+
+Correctness bars:
+
+  * a WARM start — engine or trainer — reaches its first token/step
+    with ZERO fresh XLA compiles (compile-cache miss/store counters,
+    engine TRACE_COUNTS and the jit wrappers' pjit ``_cache_size`` are
+    the tripwires) and outputs BITWISE-equal to the uncached path;
+  * the cache can never make anything worse: a corrupt payload, a
+    tampered manifest, or a version mismatch quarantines the entry and
+    falls back to a clean fresh compile (never-fails contract);
+  * two engines racing to publish the same entry both succeed and the
+    directory verifies clean (atomic tmp+os.replace publish);
+  * a replica worker's ``"checkpoint"`` spec key restores verified
+    params (falling back to init_seed when absent), and the router's
+    auto-respawn brings a DEAD replica back through the
+    quarantine → probe → canary path with streams bitwise-preserved.
+
+Engine geometry mirrors tests/test_router.py (gpt2 "test", 2 layers,
+max_seq_len 64, slots 3, bucket 16) so the uncached reference engines
+ride the suite's shared jit cache.
+"""
+
+import dataclasses
+import functools
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.runtime.compile_cache import (
+    CompileCache,
+    main as cache_cli,
+    stats_snapshot,
+)
+from pytorchdistributed_tpu.serving import ReplicaRouter, ServingEngine
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.engine import (
+    decode_tick,
+    params_finite,
+    prefill_into_slot,
+)
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    return model, params, dm
+
+
+def _ref(prompt, n):
+    _, params, dm = _setup()
+    return np.asarray(generate(dm, params, jnp.asarray(prompt)[None],
+                               max_new_tokens=n))[0]
+
+
+def _prompts(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+            for m in (5, 9, 7, 11, 6, 8, 4, 10)[:n]]
+
+
+def _delta(before):
+    return {k: v - before.get(k, 0) for k, v in stats_snapshot().items()
+            if v - before.get(k, 0)}
+
+
+def _engine(cache, **kw):
+    model, params, _ = _setup()
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("prefill_bucket", 16)
+    return ServingEngine(model, params, compile_cache=cache, **kw)
+
+
+# ----------------------------------------------------------------------
+# cache-core units
+
+
+@jax.jit
+def _axpy(a, x, y):
+    return a * x + y
+
+
+def _axpy_compile(args):
+    return lambda: _axpy.lower(*args).compile()
+
+
+def test_key_components_all_enter_the_digest(tmp_path):
+    """Every advertised key component — name, avals, dtype, statics,
+    config hash, donation — must move the digest; identical inputs must
+    reproduce it (the cross-process contract)."""
+    cache = CompileCache(tmp_path, events=None)
+    args = (jnp.float32(2.0), jnp.ones((4,)), jnp.ones((4,)))
+    base_kw = dict(statics="s", config_hash="c", donation="d")
+    _, base = cache.entry_key("p", args, **base_kw)
+    _, again = cache.entry_key("p", args, **base_kw)
+    assert base == again
+    variants = [
+        cache.entry_key("q", args, **base_kw),
+        cache.entry_key("p", (jnp.float32(2.0), jnp.ones((8,)),
+                              jnp.ones((8,))), **base_kw),
+        cache.entry_key("p", (jnp.float32(2.0), jnp.ones((4,), jnp.int32),
+                              jnp.ones((4,))), **base_kw),
+        cache.entry_key("p", args, statics="t", config_hash="c",
+                        donation="d"),
+        cache.entry_key("p", args, statics="s", config_hash="x",
+                        donation="d"),
+        cache.entry_key("p", args, statics="s", config_hash="c",
+                        donation="e"),
+    ]
+    digests = [d for _, d in variants]
+    assert base not in digests and len(set(digests)) == len(digests)
+
+
+def test_roundtrip_miss_then_hit_bitwise(tmp_path):
+    """miss → compile + publish; a second cache instance (a 'restarted
+    process') hits, deserializes and computes the identical result."""
+    args = (jnp.float32(3.0), jnp.arange(4.0), jnp.ones((4,)))
+    c1 = CompileCache(tmp_path, events=None)
+    before = stats_snapshot()
+    compiled, outcome = c1.load_or_compile("axpy", _axpy_compile(args),
+                                           args)
+    assert outcome == "miss"
+    want = np.asarray(compiled(*args))
+    c2 = CompileCache(tmp_path, events=None)
+    compiled2, outcome2 = c2.load_or_compile(
+        "axpy", lambda: pytest.fail("hit must not compile"), args)
+    assert outcome2 == "hit"
+    np.testing.assert_array_equal(np.asarray(compiled2(*args)), want)
+    assert _delta(before) == {"miss": 1, "store": 1, "hit": 1}
+
+
+def test_corrupt_payload_quarantined_then_clean_recompile(tmp_path):
+    """A bit-flipped payload must cost a quarantine + one fresh compile
+    — never an exception, never a wrong executable."""
+    args = (jnp.float32(1.0), jnp.arange(4.0), jnp.zeros((4,)))
+    cache = CompileCache(tmp_path, events=None)
+    cache.load_or_compile("axpy", _axpy_compile(args), args)
+    (bin_path,) = [p for p in tmp_path.iterdir() if p.suffix == ".bin"]
+    blob = bytearray(bin_path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    bin_path.write_bytes(bytes(blob))
+    before = stats_snapshot()
+    compiled, outcome = cache.load_or_compile("axpy", _axpy_compile(args),
+                                              args)
+    assert outcome == "miss"  # the defect fell back to a fresh compile
+    np.testing.assert_array_equal(np.asarray(compiled(*args)),
+                                  np.arange(4.0))
+    d = _delta(before)
+    assert d.get("quarantined") == 1 and d.get("store") == 1
+    qdir = tmp_path / "quarantine"
+    assert qdir.is_dir() and any(qdir.iterdir())
+    # the re-published entry is clean: next load is a pure hit
+    _, outcome = CompileCache(tmp_path, events=None).load_or_compile(
+        "axpy", lambda: pytest.fail("should hit"), args)
+    assert outcome == "hit"
+
+
+def test_version_mismatch_quarantined(tmp_path):
+    """A manifest recording a different jaxlib (tampered, or a drifted
+    key scheme) must quarantine, not load: an executable serialized by
+    another toolchain can crash the process from native code."""
+    args = (jnp.float32(1.0), jnp.arange(4.0), jnp.zeros((4,)))
+    cache = CompileCache(tmp_path, events=None)
+    cache.load_or_compile("axpy", _axpy_compile(args), args)
+    (man,) = [p for p in tmp_path.iterdir() if p.suffix == ".json"]
+    meta = json.loads(man.read_text())
+    meta["jaxlib"] = "0.0.1"
+    man.write_text(json.dumps(meta))
+    before = stats_snapshot()
+    assert cache.load("axpy", args) is None
+    assert _delta(before).get("quarantined") == 1
+
+
+def test_concurrent_publish_race_is_safe(tmp_path):
+    """Two engines racing to publish the same entry (the N-replica
+    cold start): both must come back with working executables and the
+    directory must verify clean — atomic tmp+os.replace, last writer
+    wins with identical content."""
+    args = (jnp.float32(2.0), jnp.arange(4.0), jnp.ones((4,)))
+    results, errors = [], []
+
+    def worker():
+        try:
+            cache = CompileCache(tmp_path, events=None)
+            compiled, _ = cache.load_or_compile("axpy",
+                                                _axpy_compile(args), args)
+            results.append(np.asarray(compiled(*args)))
+        except Exception as e:  # noqa: BLE001 — the test's whole point
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors and len(results) == 4
+    for r in results:
+        np.testing.assert_array_equal(r, 2.0 * np.arange(4.0) + 1.0)
+    verdicts = CompileCache(tmp_path, events=None).verify()
+    assert verdicts and all(ok for _, ok, _ in verdicts), verdicts
+
+
+# ----------------------------------------------------------------------
+# engine integration
+
+
+def test_engine_warm_start_zero_compiles_bitwise(tmp_path):
+    """The headline: a restarted engine over a warm cache reaches its
+    tokens with ZERO fresh compiles — no traces (TRACE_COUNTS), no jit
+    compiles (pjit _cache_size), no cache misses — and every stream is
+    bitwise what the uncached engine produces."""
+    prompts = _prompts(3)
+    cold = _engine(str(tmp_path))
+    cold.warmup(prompt_lens=(16,))
+    assert set(cold.aot_outcomes.values()) == {"miss"}
+    for p in prompts:
+        r = cold.submit(p, max_new_tokens=6)
+        cold.run_until_idle()
+        np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    cold.close()
+
+    traces = dict(serving_engine.TRACE_COUNTS)
+    sizes = (decode_tick._cache_size(), prefill_into_slot._cache_size(),
+             params_finite._cache_size())
+    before = stats_snapshot()
+    warm = _engine(str(tmp_path))
+    warm.warmup(prompt_lens=(16,))
+    assert set(warm.aot_outcomes.values()) == {"hit"}
+    for p in prompts:
+        r = warm.submit(p, max_new_tokens=6)
+        warm.run_until_idle()
+        np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    warm.close()
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    assert (decode_tick._cache_size(), prefill_into_slot._cache_size(),
+            params_finite._cache_size()) == sizes
+    d = _delta(before)
+    assert "miss" not in d and "store" not in d, d
+    assert d.get("hit", 0) >= 3
+
+
+def test_engine_paged_warm_start_bitwise(tmp_path):
+    """Paged engine (block pool + radix + chunked prefill) through the
+    cache: warm start all-hits, streams bitwise vs generate()."""
+    prompts = _prompts(3, seed=3)
+    for leg in ("cold", "warm"):
+        before = stats_snapshot()
+        eng = _engine(str(tmp_path), block_size=8)
+        eng.warmup(prompt_lens=(16,))
+        want = {"miss"} if leg == "cold" else {"hit"}
+        assert set(eng.aot_outcomes.values()) == want, (leg,
+                                                        eng.aot_outcomes)
+        for p in prompts:
+            r = eng.submit(p, max_new_tokens=6)
+            eng.run_until_idle()
+            np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+        eng.close()
+        if leg == "warm":
+            assert "miss" not in _delta(before)
+
+
+def test_warmup_collapses_to_one_round_with_cache(tmp_path):
+    """The two-round-per-bucket warmup exists only to absorb the jit
+    fresh-vs-committed-cache recompile; AOT dispatch has a fixed
+    convention, so warmup must pay exactly one dummy request per
+    bucket (TRACE_COUNTS moves once per program on a cold cache via
+    lower(), not at all on a warm one)."""
+    eng = _engine(str(tmp_path))
+    eng.warmup(prompt_lens=(16, 32))
+    # one AOT program per prefill bucket + the tick + the probe — the
+    # complete program set for this engine shape, resolved in ONE round
+    assert set(eng.aot_outcomes) == {"prefill_b16", "prefill_b32",
+                                     "decode_tick", "params_finite"}
+    eng.close()
+    warm_traces = dict(serving_engine.TRACE_COUNTS)
+    eng2 = _engine(str(tmp_path))
+    eng2.warmup(prompt_lens=(16, 32))
+    eng2.close()
+    assert dict(serving_engine.TRACE_COUNTS) == warm_traces
+
+
+def test_cache_failure_falls_back_to_jit_with_full_warmup(tmp_path,
+                                                          monkeypatch):
+    """The never-fails floor: if the cache layer itself blows up on
+    every program, the engine must serve bitwise from the plain jit
+    path — and warmup must still run the jit path's SECOND round (the
+    fresh-vs-committed recompile absorber), so the first real request
+    pays no compile."""
+    def boom(self, *a, **k):
+        raise RuntimeError("cache exploded")
+
+    monkeypatch.setattr(CompileCache, "load_or_compile", boom)
+    eng = _engine(str(tmp_path))
+    eng.warmup(prompt_lens=(16,))
+    assert eng.aot_outcomes == {}          # nothing resolved AOT
+    assert eng._aot_failed                 # everything fell back
+    traces = dict(serving_engine.TRACE_COUNTS)
+    p = _prompts(1)[0]
+    r = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    assert dict(serving_engine.TRACE_COUNTS) == traces  # no retrace
+    eng.close()
+
+
+# ----------------------------------------------------------------------
+# CLI: ls / verify / gc / prewarm
+
+
+def test_cli_ls_verify_gc(tmp_path, capsys):
+    args = (jnp.float32(1.0), jnp.arange(4.0), jnp.zeros((4,)))
+    cache = CompileCache(tmp_path, events=None)
+    cache.load_or_compile("axpy", _axpy_compile(args), args)
+    assert cache_cli(["ls", str(tmp_path)]) == 0
+    assert "axpy" in capsys.readouterr().out
+    assert cache_cli(["verify", str(tmp_path)]) == 0
+    (bin_path,) = [p for p in tmp_path.iterdir() if p.suffix == ".bin"]
+    bin_path.write_bytes(b"garbage")
+    assert cache_cli(["verify", str(tmp_path)]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+    assert cache_cli(["gc", str(tmp_path), "--keep", "0"]) == 0
+    assert not [p for p in tmp_path.iterdir() if p.suffix == ".bin"]
+
+
+def test_cli_prewarm_then_worker_starts_all_hits(tmp_path):
+    """Deploy-time prewarm: the CLI compiles + serializes every program
+    a replica spec needs; a worker engine built from the SAME spec then
+    warms entirely from the cache."""
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1, "warmup_lens": [16],
+            "engine": {"num_slots": 3, "prefill_bucket": 16}}
+    assert cache_cli(["prewarm", str(tmp_path),
+                      "--spec", json.dumps(spec)]) == 0
+    from pytorchdistributed_tpu.serving.replica_worker import _build_engine
+
+    before = stats_snapshot()
+    spec["compile_cache"] = str(tmp_path)
+    eng = _build_engine(spec)
+    eng.warmup(prompt_lens=[16])
+    assert set(eng.aot_outcomes.values()) == {"hit"}, eng.aot_outcomes
+    p = _prompts(1)[0]
+    r = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    eng.close()
+    assert "miss" not in _delta(before)
+
+
+# ----------------------------------------------------------------------
+# replica worker: the "checkpoint" spec key
+
+
+def test_worker_checkpoint_key_restores_verified_params(tmp_path):
+    """The replica_worker docstring's promise: a spec "checkpoint"
+    loads verified weights (a TrainState-shaped checkpoint yields its
+    params subtree); the engine then serves exactly those weights."""
+    from pytorchdistributed_tpu.serving.replica_worker import _build_engine
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    _, params, _ = _setup()
+    state = {"step": jnp.int32(7), "params": params,
+             "opt_state": {"nu": jnp.zeros(3)}}
+    with CheckpointManager(tmp_path / "ckpt") as mgr:
+        mgr.save(7, state)
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 999,  # decoy: must NOT be used
+            "checkpoint": str(tmp_path / "ckpt"),
+            "engine": {"num_slots": 3, "prefill_bucket": 16}}
+    eng = _build_engine(spec)
+    eng.warmup(prompt_lens=(16,))
+    p = _prompts(1)[0]
+    r = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    eng.close()
+
+
+def test_worker_checkpoint_absent_falls_back_to_seed(tmp_path,
+                                                     monkeypatch):
+    """An absent/empty checkpoint must not kill the worker (it would
+    die again on every respawn): it falls back to init_seed and logs
+    the TelemetryEvent."""
+    from pytorchdistributed_tpu.serving.replica_worker import _load_params
+    from pytorchdistributed_tpu.telemetry.events import (
+        EVENT_REPLICA_RESTORE_FALLBACK,
+        read_events,
+    )
+
+    monkeypatch.setenv("PTD_TELEMETRY_DIR", str(tmp_path / "tele"))
+    model, _, _ = _setup()
+    spec = {"init_seed": 1, "checkpoint": str(tmp_path / "nope")}
+    params = _load_params(spec, model)
+    want = jax.jit(model.init)(jax.random.key(1),
+                               jnp.zeros((1, 8), jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(want)[0]))
+    kinds = [e.kind for e in read_events(tmp_path / "tele")]
+    assert EVENT_REPLICA_RESTORE_FALLBACK in kinds
+
+
+# ----------------------------------------------------------------------
+# trainer integration
+
+
+def _trainer(cache, lr=1e-3):
+    import optax
+
+    from pytorchdistributed_tpu.models import MLP
+    from pytorchdistributed_tpu.runtime.mesh import create_mesh
+    from pytorchdistributed_tpu.training import Trainer, mse_loss
+
+    return Trainer(MLP(features=(32, 8)), optax.adamw(lr), mse_loss,
+                   mesh=create_mesh(), strategy="dp", log_every=10**9,
+                   compile_cache=cache)
+
+
+@functools.cache
+def _train_batch():
+    from pytorchdistributed_tpu.data import (
+        DataLoader,
+        SyntheticRegressionDataset,
+    )
+
+    ds = SyntheticRegressionDataset(size=64, in_dim=16, out_dim=8, seed=0)
+    return next(iter(DataLoader(ds, batch_size=16, num_replicas=1,
+                                rank=0)))
+
+
+def test_trainer_warm_restart_zero_jit_compiles(tmp_path):
+    """A relaunched trainer over a warm cache: the step executable
+    deserializes, train_step dispatches through it (the jit wrapper's
+    pjit cache stays EMPTY — zero XLA compiles), and the loss curve is
+    bitwise the uncached one's."""
+    batch = _train_batch()
+
+    def losses(t, steps=3):
+        return [float(t.train_step(batch)["loss"]) for _ in range(steps)]
+
+    ref = losses(_trainer(None))
+    assert losses(_trainer(str(tmp_path))) == ref       # cold: parity
+    before = stats_snapshot()
+    warm = _trainer(str(tmp_path))
+    assert losses(warm) == ref
+    assert warm._step_fn._cache_size() == 0             # never jit-compiled
+    d = _delta(before)
+    assert d.get("hit") == 1 and "miss" not in d, d
+    # step_accounting reuses the SAME cached executable: no extra load
+    acc = warm.step_accounting(batch)
+    assert acc is not None
+    assert _delta(before).get("hit") == 1
+
+
+def test_trainer_cache_keyed_on_lowered_hlo_not_shapes(tmp_path):
+    """Two trainers with identical shapes but different optimizer
+    hyperparameters lower to different programs — the HLO-hash key must
+    MISS, never serve one the other's executable (the silent-wrong-hit
+    failure mode a shapes-only key would have)."""
+    batch = _train_batch()
+    t1 = _trainer(str(tmp_path), lr=1e-3)
+    t1.train_step(batch)
+    before = stats_snapshot()
+    t2 = _trainer(str(tmp_path), lr=3e-3)
+    t2.train_step(batch)
+    d = _delta(before)
+    assert d.get("miss") == 1 and "hit" not in d, d
+
+
+# ----------------------------------------------------------------------
+# router auto-respawn (in-process; the subprocess e2e is full-tier)
+
+
+def test_router_respawn_rejoins_and_serves(tmp_path):
+    """replica_crash → DEAD → auto-respawn (budgeted, backoff) →
+    QUARANTINED → clean-probe streak → canary → HEALTHY and serving
+    again, with every stream — failed-over and post-respawn — bitwise
+    the single-engine reference. A crash is a transient, not a
+    permanent capacity loss."""
+    from pytorchdistributed_tpu.faults.inject import (
+        FaultInjector,
+        FaultPlan,
+    )
+    from pytorchdistributed_tpu.faults.retry import RetryPolicy
+    from pytorchdistributed_tpu.serving import HEALTHY
+    from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    model, params, _ = _setup()
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=4,replica=0"))
+    router = ReplicaRouter(
+        model, params, replicas=2,
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16),
+        warmup_lens=(16, 32), faults=inj,
+        respawn_budget=1, rejoin_after=2,
+        respawn_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0),
+        telemetry=RouterTelemetry(tmp_path))
+    router.warmup()
+    prompts = _prompts(5)
+    reqs = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(r.output_ids, _ref(p, 8))
+    # second wave: the respawn gate has opened by now — replica 0 comes
+    # back through quarantine + canary and takes traffic again
+    reqs2 = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    for p, r in zip(prompts, reqs2):
+        np.testing.assert_array_equal(r.output_ids, _ref(p, 8))
+    s = router.summary()
+    assert s["respawns"] == 1 and s["rejoins"] == 1, s
+    assert router._status[0] == HEALTHY
+    reqs3 = [router.submit(p, max_new_tokens=8) for p in prompts]
+    router.run_until_idle()
+    assert router.summary()["served_by"].get(0, 0) > 0
+    router.close()
+    report = render(tmp_path)
+    assert "respawns 1" in report and "respawn" in report
+
+
+def test_subprocess_respawn_from_checkpoint_and_cache(monkeypatch,
+                                                      tmp_path):
+    """The acceptance chaos e2e, multi-process shape: subprocess
+    workers restoring weights from a verified checkpoint and
+    executables from a prewarmed compile cache; PTD_FAULTS crashes
+    worker 0 from inside (os._exit mid-protocol); the router fails its
+    streams over (bitwise), auto-RESPAWNS the worker — which rejoins
+    through the quarantine probes and serves again with bitwise-equal
+    streams — and teardown leaves no orphan. The one-shot fault marker
+    persists in PTD_FAULTS_STATE, so the respawned incarnation does not
+    crash-loop."""
+    import time as _time
+
+    from pytorchdistributed_tpu.faults import inject as faults_inject
+    from pytorchdistributed_tpu.faults.retry import RetryPolicy
+    from pytorchdistributed_tpu.serving import HEALTHY
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    _, params, _ = _setup()
+    with CheckpointManager(tmp_path / "ckpt") as mgr:
+        mgr.save(1, {"step": jnp.int32(1), "params": params,
+                     "opt_state": {"nu": jnp.zeros(1)}})
+    monkeypatch.setenv("PTD_FAULTS", "replica_crash@tick=5,replica=0")
+    monkeypatch.setenv("PTD_FAULTS_STATE", str(tmp_path / "faults"))
+    faults_inject.reset_active()
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "checkpoint": str(tmp_path / "ckpt"),
+            "compile_cache": str(tmp_path / "cache"),
+            "engine": {"num_slots": 2, "prefill_bucket": 16}}
+    router = ReplicaRouter(
+        workers=[spec, spec], warmup_lens=(16, 32), faults=None,
+        respawn_budget=1, rejoin_after=1,
+        respawn_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0))
+    try:
+        router.warmup()
+        prompts = _prompts(4)
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        assert router.summary()["replicas_lost"] == 1
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.output_ids, _ref(p, 6),
+                                          err_msg=f"request {r.id}")
+        # idle-tick until the respawned worker has warmed (from the
+        # entries the first incarnation published) and rejoined
+        deadline = _time.time() + 180
+        while (_time.time() < deadline
+               and (router.summary()["respawns"] < 1
+                    or router._status[0] != HEALTHY)):
+            router.step()
+        assert router.summary()["respawns"] == 1
+        assert router._status[0] == HEALTHY
+        reqs2 = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        for p, r in zip(prompts, reqs2):
+            np.testing.assert_array_equal(r.output_ids, _ref(p, 6),
+                                          err_msg=f"request {r.id}")
+        assert router.summary()["served_by"].get(0, 0) > 0
+        procs = [rep.proc for rep in router._replicas]
+    finally:
+        router.close()
+        faults_inject.reset_active()
+    deadline = _time.time() + 15
+    while (_time.time() < deadline
+           and any(p.poll() is None for p in procs)):
+        _time.sleep(0.1)
+    assert all(p.poll() is not None for p in procs), \
+        [p.poll() for p in procs]
+
+
+def test_respawn_warmup_timeout_declares_wedged_worker_dead():
+    """A respawned worker that wedges DURING its async startup must not
+    park its slot in QUARANTINED forever: past respawn_warmup_s the
+    router declares it hung — spending the next budgeted attempt (or
+    finally giving up) instead of silently losing capacity."""
+    import time as _time
+
+    from pytorchdistributed_tpu.serving import DEAD, QUARANTINED
+
+    model, params, _ = _setup()
+    router = ReplicaRouter(
+        model, params, replicas=2,
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16),
+        warmup_lens=(16,), faults=None, respawn_budget=1,
+        respawn_warmup_s=0.01)
+    router.warmup()
+
+    class Wedged:  # a respawned subprocess worker stuck in startup
+        index = 0
+        hang_grace_s = 0.0
+        faults_in_worker = True
+        alive = True
+        _warming = True
+
+        def health(self):
+            return {"alive": True, "progress": -1}
+
+        def probe(self, exclusive=False):
+            return False
+
+        def drain(self):
+            return []
+
+        def close(self):
+            pass
+
+    router._replicas[0] = Wedged()
+    router._status[0] = QUARANTINED
+    router._respawns[0] = 1  # this IS the budgeted respawn, wedged
+    router._warming_deadline[0] = _time.perf_counter() - 1.0
+    router.step()
+    assert router._status[0] == DEAD
+    # budget spent: the fleet serves on the survivor, no infinite park
+    p = _prompts(1)[0]
+    r = router.submit(p, max_new_tokens=6)
+    router.run_until_idle()
+    np.testing.assert_array_equal(r.output_ids, _ref(p, 6))
+    router.close()
+
+
+def test_router_respawn_budget_exhausts(tmp_path):
+    """With the budget spent, a crash-looping replica stays DEAD — the
+    pre-ISSUE-10 behavior is the floor, and the fleet keeps serving on
+    the survivor."""
+    from pytorchdistributed_tpu.faults.inject import (
+        FaultInjector,
+        FaultPlan,
+    )
+    from pytorchdistributed_tpu.faults.retry import RetryPolicy
+    from pytorchdistributed_tpu.serving import DEAD
+
+    model, params, _ = _setup()
+    # every rejoined incarnation of replica 0 is crashed again
+    inj = FaultInjector(FaultPlan.parse(
+        "replica_crash@tick=3,replica=0; replica_crash@tick=40,replica=0;"
+        " replica_crash@tick=80,replica=0"))
+    router = ReplicaRouter(
+        model, params, replicas=2,
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16),
+        warmup_lens=(16,), faults=inj, respawn_budget=1, rejoin_after=1,
+        respawn_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0))
+    router.warmup()
+    prompts = _prompts(4)
+    for wave in range(3):
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle()
+        assert all(r.finish_reason == "length" for r in reqs), wave
+        for _ in range(30):  # spin idle ticks so chaos + respawn fire
+            router.step()
+    s = router.summary()
+    assert s["respawns"] == 1  # budget 1: the second death is final
+    assert router._status[0] == DEAD
+    router.close()
