@@ -1046,6 +1046,139 @@ def bench_router() -> dict:
     return result
 
 
+def bench_disagg() -> dict:
+    """Disaggregated serving A/B (ISSUE 12): the SAME bursty
+    shared-prefix trace (one hot system prompt + unique tails, arriving
+    in two near-simultaneous bursts — the chat-frontend worst case where
+    long prefills stall resident decodes) served two ways at identical
+    fleet size and HBM:
+
+      * ``colocated``    — every replica role 'both' (the PR 9 shape);
+      * ``disaggregated``— prefill-role replicas chunk-prefill and hand
+        KV blocks to a decode-role replica over the KV stream, with the
+        fleet prefix index steering siblings onto cached blocks (and
+        shipping them on a remote hit).
+
+    Stamps per leg: TTFT p50/p99 (queue wait included), decode
+    tokens/s (mean over replicas that decoded), fleet-total
+    prefill_chunks (the "shared prefix prefilled once per fleet" claim
+    — fewer chunks at equal traffic), prefix/cross-replica hit rates,
+    handoff + prefix-ship counters and kv_stream_bytes, plus the
+    recompile tripwire (must stamp 0 — handoffs reuse the warmed KV
+    stream programs). The headline is the disagg-vs-colocated TTFT p99
+    ratio. PTD_DISAGG_AB=0 skips the colocated twin (stamps the disagg
+    leg alone). Knobs: PTD_DISAGG_{PREFILL,DECODE,SLOTS,REQUESTS,
+    MAX_NEW,BLOCK,PREFIX_LEN}; PTD_QUANT rides the model config."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import (
+        ROLE_BOTH,
+        ROLE_DECODE,
+        ROLE_PREFILL,
+        ReplicaRouter,
+    )
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    n_prefill = int(os.environ.get("PTD_DISAGG_PREFILL", "2"))
+    n_decode = int(os.environ.get("PTD_DISAGG_DECODE", "1"))
+    num_slots = int(os.environ.get("PTD_DISAGG_SLOTS", "3"))
+    n_requests = int(os.environ.get("PTD_DISAGG_REQUESTS", "18"))
+    max_new = int(os.environ.get("PTD_DISAGG_MAX_NEW", "16"))
+    block = int(os.environ.get("PTD_DISAGG_BLOCK", "16"))
+    prefix_len = int(os.environ.get("PTD_DISAGG_PREFIX_LEN", "96"))
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=256,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(23)
+    system = rng.integers(0, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate([
+        system, rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)])
+        for _ in range(n_requests)]
+    # one LEADER request warms the shared prefix on a single replica,
+    # then two bursts — not a Poisson trickle: the second wave lands
+    # while the first is still decoding, exactly the prefill/decode
+    # interference disaggregation is supposed to remove. The stagger is
+    # what makes fleet prefix reuse observable: with an all-at-once
+    # burst every replica prefills the prefix itself before any
+    # frontier publishes, and no steering or shipping can happen
+    arrivals = np.concatenate([
+        [0.0],
+        np.full((n_requests - 1) // 2, 0.4),
+        np.full(n_requests - 1 - (n_requests - 1) // 2, 0.65)])
+    ek = dict(num_slots=num_slots, prefill_bucket=64, block_size=block,
+              prefill_chunk=64)
+
+    def leg(roles) -> dict:
+        router = ReplicaRouter(model, params, replicas=len(roles),
+                               roles=roles, engine_kwargs=ek,
+                               warmup_lens=(64,), faults=None)
+        router.warmup()
+        traces0 = dict(serving_engine.TRACE_COUNTS)
+        reqs = _drive_router_trace(router, list(prompts),
+                                   arrivals.copy(), max_new)
+        recompiles = (sum(serving_engine.TRACE_COUNTS.values())
+                      - sum(traces0.values()))
+        s = router.summary()
+        engines = [r.engine.summary() for r in router._replicas]
+        router.close()
+        decoded = [e["decode_tokens_per_s"] for e in engines
+                   if e.get("decode_tokens_per_s")]
+        unfinished = sum(1 for q in reqs
+                         if q.finish_reason not in ("length", "stop"))
+        return {
+            "roles": roles,
+            "ttft_ms_p50": s.get("ttft_ms_p50"),
+            "ttft_ms_p99": s.get("ttft_ms_p99"),
+            "decode_tokens_per_s": (round(sum(decoded) / len(decoded), 2)
+                                    if decoded else None),
+            "prefill_chunks_total": sum(e.get("prefill_chunks", 0)
+                                        for e in engines),
+            "prefix_hit_rate": round(sum(
+                e.get("prefix_hit_tokens", 0) - e.get(
+                    "remote_hit_tokens", 0) for e in engines) / max(1, sum(
+                        e.get("admitted_tokens", 0) for e in engines)), 4),
+            "cross_replica_hit_rate": s.get("cross_replica_hit_rate"),
+            "handoffs": s.get("handoffs", 0),
+            "handoff_failures": s.get("handoff_failures", 0),
+            "prefix_ships": s.get("prefix_ships", 0),
+            "kv_stream_bytes": s.get("kv_stream_bytes", 0),
+            "unfinished": unfinished,        # must stamp 0
+            "recompiles": recompiles,        # must stamp 0
+        }
+
+    disagg = leg([ROLE_PREFILL] * n_prefill + [ROLE_DECODE] * n_decode)
+    result = {
+        "metric": "disagg_ttft_p99_ratio",
+        "value": None, "unit": "x (colocated / disagg; > 1 = disagg wins)",
+        "requests": n_requests, "prefix_len": prefix_len,
+        "block_size": block, "num_slots": num_slots,
+        "disaggregated": disagg,
+    }
+    if os.environ.get("PTD_DISAGG_AB", "1") != "0":
+        colo = leg([ROLE_BOTH] * (n_prefill + n_decode))
+        result["colocated"] = colo
+        if disagg["ttft_ms_p99"] and colo["ttft_ms_p99"]:
+            result["value"] = round(
+                colo["ttft_ms_p99"] / disagg["ttft_ms_p99"], 3)
+        if (disagg["decode_tokens_per_s"]
+                and colo["decode_tokens_per_s"]):
+            result["decode_tokens_ratio"] = round(
+                disagg["decode_tokens_per_s"]
+                / colo["decode_tokens_per_s"], 3)
+    _stamp_overrides(result, ("PTD_DISAGG_PREFILL", "PTD_DISAGG_DECODE",
+                              "PTD_DISAGG_SLOTS", "PTD_DISAGG_REQUESTS",
+                              "PTD_DISAGG_MAX_NEW", "PTD_DISAGG_BLOCK",
+                              "PTD_DISAGG_PREFIX_LEN", "PTD_DISAGG_AB",
+                              "PTD_QUANT"))
+    return result
+
+
 def _coldstart_worker(cache_dir: str) -> None:
     """Child of bench_coldstart: ONE fresh process standing up a serving
     engine against ``cache_dir`` (jax import → model init → engine →
@@ -1534,7 +1667,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
            "serve": bench_serve, "router": bench_router,
-           "coldstart": bench_coldstart,
+           "disagg": bench_disagg, "coldstart": bench_coldstart,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 

@@ -207,6 +207,28 @@ _QUICK = (
     "test_compile_cache.py::test_router_respawn_rejoins_and_serves",
     "test_compile_cache.py::test_router_respawn_budget_exhausts",
     "test_compile_cache.py::test_respawn_warmup_timeout_declares",
+    # prefill/decode disaggregation (ISSUE 12): FleetPrefixIndex +
+    # radix local/remote-split units, the wire codec round-trip, the
+    # KV export/import bitwise anchors (ragged block-boundary lengths,
+    # seeded sampling, prefix-hit offset export), import validation
+    # walls, the disagg router parity + both mid-handoff death
+    # scenarios, deterministic fleet prefix shipping, the disagg
+    # zero-recompile tripwire and the report columns — all in-process
+    # on the suite-shared test-size geometry. The SUBPROCESS e2e
+    # (spawns jax-importing workers) stays full-suite-only.
+    "test_disagg.py::test_fleet_prefix_index_units",
+    "test_disagg.py::test_radix_remote_split_and_frontier",
+    "test_disagg.py::test_kv_payload_wire_roundtrip",
+    "test_disagg.py::test_kv_roundtrip_bitwise_ragged_lengths",
+    "test_disagg.py::test_kv_roundtrip_bitwise_seeded_sampling",
+    "test_disagg.py::test_kv_export_after_prefix_hit_bitwise",
+    "test_disagg.py::test_import_validation_walls",
+    "test_disagg.py::test_disagg_router_bitwise_and_handoffs",
+    "test_disagg.py::test_disagg_decode_death_after_import_is_lossless",
+    "test_disagg.py::test_disagg_prefill_death_with_parked_streams_is_lossless",
+    "test_disagg.py::test_fleet_prefix_steering_ships_blocks",
+    "test_disagg.py::test_zero_recompiles_steady_state_disagg",
+    "test_disagg.py::test_report_cli_renders_disagg_columns",
 )
 
 

@@ -64,6 +64,18 @@ def main():
                              "ReplicaRouter over this many in-process "
                              "engine replicas (README 'Replicated "
                              "serving & failover')")
+    parser.add_argument("--prefill-replicas", type=int, default=0,
+                        help="with --decode-replicas: DISAGGREGATED "
+                             "topology (README 'Disaggregated serving') "
+                             "— this many prefill-role replicas chunk-"
+                             "prefill each prompt, then hand the KV "
+                             "blocks to a decode-role replica over the "
+                             "KV stream; overrides --replicas and "
+                             "implies the paged engine")
+    parser.add_argument("--decode-replicas", type=int, default=0,
+                        help="decode-role replica count for the "
+                             "disaggregated topology (see "
+                             "--prefill-replicas)")
     parser.add_argument("--chaos", action="store_true",
                         help="with --replicas > 1: crash replica 0 "
                              "mid-trace — watch the router redispatch "
@@ -72,6 +84,23 @@ def main():
     args = parser.parse_args()
     if args.spec_k and not args.block_size:
         args.block_size = 16  # spec requires the paged engine
+    roles = None
+    if args.prefill_replicas or args.decode_replicas:
+        if not (args.prefill_replicas and args.decode_replicas):
+            parser.error("--prefill-replicas and --decode-replicas go "
+                         "together (a disaggregated fleet needs both "
+                         "halves)")
+        if args.spec_k:
+            parser.error("--spec-k and the disaggregated topology are "
+                         "mutually exclusive (KV handoff carries no "
+                         "draft state)")
+        from pytorchdistributed_tpu.serving import ROLE_DECODE, ROLE_PREFILL
+
+        roles = ([ROLE_PREFILL] * args.prefill_replicas
+                 + [ROLE_DECODE] * args.decode_replicas)
+        args.replicas = len(roles)
+        if not args.block_size:
+            args.block_size = 16  # KV handoff requires the paged engine
 
     ptd.init_process_group()
     cfg = llama_config("test", max_seq_len=64)
@@ -124,7 +153,7 @@ def main():
             print(f"--- chaos armed: {spec} ---")
             router_kw["faults"] = FaultInjector(FaultPlan.parse(spec))
         router = ReplicaRouter(
-            model, params, replicas=args.replicas,
+            model, params, replicas=args.replicas, roles=roles,
             engine_kwargs=dict(num_slots=args.num_slots,
                                prefill_bucket=16,
                                block_size=args.block_size,

@@ -23,7 +23,12 @@
                    subprocess workers) with lossless mid-stream
                    failover, load shedding, quarantine/rejoin and
                    graceful SIGTERM drain; replica_worker.py is the
-                   subprocess side
+                   subprocess side. ``roles=`` (ISSUE 12) splits the
+                   fleet into prefill/decode resource classes — parked
+                   prefills hand off KV blocks over the wire — and a
+                   FleetPrefixIndex steers shared prefixes to the
+                   replica that already holds them (or ships the
+                   blocks), so a hot prefix is prefilled once per fleet
 
 `bench.py --mode serve` drives it under a Poisson arrival trace (plus
 the paged capacity and prefix-reuse A/Bs); examples/serve.py is the
@@ -31,10 +36,14 @@ train-then-serve demo.
 """
 
 from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
+    KVBlockPayload,
+    PrefixBlockPayload,
     Request,
     SamplingParams,
     ServingEngine,
     decode_tick,
+    kv_payload_from_wire,
+    kv_payload_to_wire,
     paged_decode_tick,
     paged_prefill_chunk,
     paged_slot_models,
@@ -44,12 +53,18 @@ from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
 )
 from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
     BlockAllocator,
+    FleetPrefixIndex,
     RadixPrefixCache,
+    block_hashes,
 )
 from pytorchdistributed_tpu.serving.router import (  # noqa: F401
     DEAD,
     HEALTHY,
     QUARANTINED,
+    ROLE_BOTH,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLES,
     InProcessReplica,
     ReplicaCrashed,
     ReplicaRouter,

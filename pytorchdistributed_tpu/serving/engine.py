@@ -61,6 +61,7 @@ tests/test_spec.py pin it).
 
 from __future__ import annotations
 
+import base64
 import collections
 import contextlib
 import dataclasses
@@ -393,6 +394,45 @@ def params_finite(weights):
     return jnp.all(jnp.stack(leaves))
 
 
+@jax.jit
+def kv_block_gather(cache, block_ids):
+    """Pool gather for the KV block stream (ISSUE 12): pull
+    ``block_ids`` rows out of every pool leaf in one compiled call.
+    ``block_ids`` is always padded to kv_pages with the trash block, so
+    EVERY export — any request length, any prefix offset — is this one
+    fixed-shape program; the host slices the trash rows off after the
+    sync. Returns the pool leaves (cached_key/cached_value per layer
+    stack) in tree-flatten order."""
+    TRACE_COUNTS["kv_block_gather"] += 1
+    return [jnp.take(leaf, block_ids, axis=leaf.ndim - 4)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(cache)
+            if _leaf_name(path) in ("cached_key", "cached_value")]
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def kv_block_scatter(cache, block_ids, payload):
+    """The import half: scatter ``payload`` (one array per pool leaf,
+    block axis padded to kv_pages like ``block_ids``) into the donated
+    pool at ``block_ids``. The pad rows carry zeros addressed at the
+    trash block — duplicate index-0 writes land harmlessly where
+    garbage already goes — so this too is ONE program for every
+    import."""
+    TRACE_COUNTS["kv_block_scatter"] += 1
+    it = iter(payload)
+
+    def put(path, leaf):
+        if _leaf_name(path) not in ("cached_key", "cached_value"):
+            return leaf
+        new = next(it)
+        axis = leaf.ndim - 4
+        moved = jnp.moveaxis(leaf, axis, 0)
+        out = moved.at[block_ids].set(
+            jnp.moveaxis(new.astype(leaf.dtype), axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs (dynamic per slot — any mix of requests
@@ -404,6 +444,123 @@ class SamplingParams:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+
+
+@dataclasses.dataclass
+class KVBlockPayload:
+    """One parked request's complete handoff state on the KV block
+    stream (ISSUE 12): everything a decode-role replica needs to
+    activate the stream mid-flight, bitwise-equal to a colocated
+    engine — the prompt, the tokens generated so far (the prefill-role
+    engine's sampled first token rides here, already delivered), the
+    sampling contract, and the exact K/V of positions [0, true_len)
+    gathered off the exporter's pool. ``leaves`` pairs each pool leaf's
+    tree-path name with its ``[num_blocks, ...]`` host array — the
+    importer checks the names against its own pool so a geometry or
+    model mismatch fails loudly instead of decoding garbage."""
+
+    prompt: np.ndarray
+    generated: list[int]
+    true_len: int
+    block_size: int
+    max_new_tokens: int
+    sampling: SamplingParams
+    stop_ids: tuple
+    leaves: list
+
+    @property
+    def num_blocks(self) -> int:
+        return -(-self.true_len // self.block_size)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.prompt.nbytes
+                   + sum(a.nbytes for _, a in self.leaves))
+
+
+@dataclasses.dataclass
+class PrefixBlockPayload:
+    """A radix-cached prefix shipped over the same KV stream (the
+    fleet prefix cache's remote-hit path): whole cached blocks of
+    ``tokens`` (a block-multiple), gathered from the owning replica's
+    pool, for the receiver to adopt into its pool + radix as REMOTE
+    entries — prefilled once per fleet, served everywhere."""
+
+    tokens: np.ndarray
+    block_size: int
+    leaves: list
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.tokens) // self.block_size
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.tokens.nbytes
+                   + sum(a.nbytes for _, a in self.leaves))
+
+
+def _np_dtype(name: str):
+    """np.dtype by name, reaching into ml_dtypes for the low-precision
+    names (bfloat16 et al.) numpy itself cannot resolve."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _leaves_to_wire(leaves) -> list:
+    return [dict(name=n, dtype=str(a.dtype), shape=list(a.shape),
+                 data=base64.b64encode(
+                     np.ascontiguousarray(a).tobytes()).decode("ascii"))
+            for n, a in leaves]
+
+
+def _leaves_from_wire(rows) -> list:
+    return [(r["name"],
+             np.frombuffer(base64.b64decode(r["data"]),
+                           dtype=_np_dtype(r["dtype"]))
+             .reshape(r["shape"]))
+            for r in rows]
+
+
+def kv_payload_to_wire(p: KVBlockPayload) -> dict:
+    """Serialize a KVBlockPayload for the subprocess worker's line-JSON
+    protocol (base64 block arrays — the same wire the submit/step ops
+    ride, so disagg needs no second transport)."""
+    return dict(prompt=[int(t) for t in p.prompt],
+                generated=list(p.generated), true_len=p.true_len,
+                block_size=p.block_size,
+                max_new_tokens=p.max_new_tokens,
+                sampling=dataclasses.asdict(p.sampling),
+                stop_ids=list(p.stop_ids),
+                leaves=_leaves_to_wire(p.leaves))
+
+
+def kv_payload_from_wire(d: dict) -> KVBlockPayload:
+    return KVBlockPayload(
+        prompt=np.asarray(d["prompt"], np.int32),
+        generated=[int(t) for t in d["generated"]],
+        true_len=int(d["true_len"]), block_size=int(d["block_size"]),
+        max_new_tokens=int(d["max_new_tokens"]),
+        sampling=SamplingParams(**d["sampling"]),
+        stop_ids=tuple(d["stop_ids"]),
+        leaves=_leaves_from_wire(d["leaves"]))
+
+
+def prefix_payload_to_wire(p: PrefixBlockPayload) -> dict:
+    return dict(tokens=[int(t) for t in p.tokens],
+                block_size=p.block_size,
+                leaves=_leaves_to_wire(p.leaves))
+
+
+def prefix_payload_from_wire(d: dict) -> PrefixBlockPayload:
+    return PrefixBlockPayload(
+        tokens=np.asarray(d["tokens"], np.int32),
+        block_size=int(d["block_size"]),
+        leaves=_leaves_from_wire(d["leaves"]))
 
 
 class Request:
@@ -447,6 +604,14 @@ class Request:
         self.prefix_hit_tokens = 0
         self.prefill_chunks = 0
         self.preemptions = 0
+        # disaggregation lifecycle (ISSUE 12): prompt tokens admitted
+        # from REMOTE (fleet-shipped) prefix blocks, and the
+        # prefill-role handoff flags — a prefill_only request parks
+        # after its first token for export_kv_blocks instead of
+        # decoding in place
+        self.remote_hit_tokens = 0
+        self.prefill_only = False
+        self.parked = False
         # speculative-decoding lifecycle (zero when spec is off): draft
         # proposals made for this request and how many the target kept —
         # accepted/draft is the request's acceptance rate
@@ -617,6 +782,11 @@ class ServingEngine:
             self._admit_order = np.zeros(num_slots, np.int64)
             self._admit_seq = itertools.count(1)
             self._prefilling: dict | None = None
+            # prefill_only requests parked after their first token,
+            # keyed by request id: {req, slot, length} — the slot holds
+            # the blocks but leaves the tick's view (all-trash table,
+            # length 0) until export_kv_blocks takes custody
+            self._prefilled: dict[int, dict] = {}
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.spec_k = spec_k
@@ -705,7 +875,7 @@ class ServingEngine:
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None, stop_ids=None,
                on_token=None, deadline_s: float | None = None,
-               generated=None) -> Request:
+               generated=None, prefill_only: bool = False) -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
         steps). ``stop_ids`` accepts a single id or a sequence.
@@ -726,7 +896,24 @@ class ServingEngine:
         uninterrupted run would have produced (greedy AND seeded
         sampling). ``max_new_tokens`` still bounds the TOTAL new-token
         stream, generated prefix included; only tokens past it are
-        delivered/streamed."""
+        delivered/streamed.
+
+        ``prefill_only`` (ISSUE 12, paged only) is the PREFILL-ROLE
+        half of disaggregation: the request runs chunked prefill,
+        delivers its first token, then PARKS instead of decoding — its
+        K/V blocks wait for ``export_kv_blocks`` to hand them to a
+        decode-role replica. A request already done at its first token
+        (stop id / max_new_tokens == 1) finishes normally and never
+        parks."""
+        if prefill_only:
+            if not self.paged:
+                raise ValueError(
+                    "prefill_only requires the paged engine "
+                    "(block_size > 0): KV blocks are the handoff unit")
+            if self.spec_k:
+                raise ValueError(
+                    "prefill_only does not compose with spec_k > 0 "
+                    "(the draft pool is not on the KV stream)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -748,6 +935,7 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens, sampling or SamplingParams(),
                       stop_ids_tuple(stop_ids), on_token,
                       deadline_s=deadline_s, generated=generated)
+        req.prefill_only = prefill_only
         req.submit_time = time.perf_counter()
         self._queue.append(req)
         return req
@@ -950,9 +1138,10 @@ class ServingEngine:
         true_len = int(tokens.size)
         bs = self.block_size
         lookup_len = ((true_len - 1) // bs) * bs
-        matched: list[int] = []
+        matched_nodes: list = []
         if self._radix is not None:
-            matched = self._radix.match(tokens[:lookup_len])
+            matched_nodes = self._radix.match_nodes(tokens[:lookup_len])
+        matched = [n.block for n in matched_nodes]
         for b in matched:  # hold them before eviction can reap them
             self._alloc.incref(b)
         m = len(matched) * bs
@@ -965,7 +1154,7 @@ class ServingEngine:
             # request can make progress
             for b in matched:
                 self._alloc.decref(b)
-            matched, m = [], 0
+            matched, matched_nodes, m = [], [], 0
             if self._radix is not None:
                 self._radix.clear()
             span = min(self._round_up(true_len, self.chunk),
@@ -976,8 +1165,13 @@ class ServingEngine:
                 self._alloc.decref(b)
             return False
         self._queue.popleft()
+        # fleet-shipped (remote) prefix nodes count separately: their
+        # tokens were prefilled on ANOTHER replica, so the local
+        # prefix_hit_rate must stay comparable to single-engine runs
+        remote_m = sum(1 for n in matched_nodes if n.remote)
         if self._radix is not None:  # ONE stat row per landed admission
-            self._radix.record_admission(len(matched), lookup_len)
+            self._radix.record_admission(len(matched), lookup_len,
+                                         remote_blocks=remote_m)
         slot = self._free.pop()
         blocks = matched + fresh
         self._slot_blocks[slot] = blocks
@@ -989,10 +1183,12 @@ class ServingEngine:
         table_row = np.zeros(self.cfg.kv_pages, np.int32)
         table_row[:len(blocks)] = blocks
         req.prefix_hit_tokens += m
+        req.remote_hit_tokens += remote_m * bs
         st = self._stats
         st["admissions"] += 1
         st["admitted_tokens"] += true_len
         st["prefix_hit_tokens"] += m
+        st["remote_hit_tokens"] += remote_m * bs
         self._prefilling = dict(
             req=req, slot=slot, tokens=tokens, true_len=true_len, pos=m,
             resume=len(req.new_tokens), table_row=table_row,
@@ -1092,6 +1288,19 @@ class ServingEngine:
         self._top_ks[slot] = req.sampling.top_k
         self._top_ps[slot] = req.sampling.top_p
         self._deliver(req, first)
+        if req.prefill_only and not req.done:
+            # PARK for handoff (ISSUE 12): the first token is
+            # delivered, the blocks hold exact K/V for positions
+            # [0, true_len) — custody now belongs to export_kv_blocks.
+            # The slot leaves the tick's view (all-trash table, length
+            # 0: garbage ticks must not write the parked K/V) and
+            # leaves _active so growth/preemption/delivery skip it.
+            del self._active[slot]
+            self._prefilled[req.id] = dict(req=req, slot=slot,
+                                           length=pf["true_len"])
+            self._tables[slot, :] = 0
+            self._lengths[slot] = 0
+            req.parked = True
         return 1
 
     def _grow_slots(self) -> None:
@@ -1142,6 +1351,252 @@ class ServingEngine:
         self._lengths[slot] = 0
         self._free.append(slot)
         self._temps[slot] = 0.0
+
+    # ------------------------------------------------------------------
+    # KV block streaming (ISSUE 12): the disaggregation transfer unit
+
+    @property
+    def parked_requests(self) -> list[Request]:
+        """Prefill-only requests parked awaiting export (in park
+        order) — what a router's handoff sweep polls."""
+        if not self.paged:
+            return []
+        return [rec["req"] for rec in self._prefilled.values()]
+
+    def _pool_leaf_names(self) -> list[str]:
+        """Tree-path names of the pool's K/V leaves, in the flatten
+        order kv_block_gather emits — the payload's integrity tags."""
+        return ["/".join(str(getattr(p, "key", p)) for p in path)
+                for path, leaf in
+                jax.tree_util.tree_leaves_with_path(self._cache)
+                if _leaf_name(path) in ("cached_key", "cached_value")]
+
+    def _gather_blocks(self, blocks) -> list:
+        """Run the ONE fixed-shape gather program over ``blocks`` (ids
+        padded to kv_pages with trash) and return named host arrays
+        with the pad rows sliced off."""
+        nb = len(blocks)
+        ids = np.zeros(self.cfg.kv_pages, np.int32)
+        ids[:nb] = blocks
+        with self._mesh_ctx():
+            gathered = self._aot_call(
+                "kv_block_gather", kv_block_gather, (),
+                (self._cache, jnp.asarray(ids)), {}, donation="")
+        out = []
+        for name, leaf in zip(self._pool_leaf_names(), gathered):
+            a = np.asarray(leaf)  # host sync
+            out.append((name, np.take(a, np.arange(nb),
+                                      axis=a.ndim - 4)))
+        self._progress += 1
+        return out
+
+    def _scatter_blocks(self, blocks, arrays) -> None:
+        """Run the ONE fixed-shape scatter program: pad ids and the
+        payload's block axis to kv_pages (pad zeros land in the trash
+        block) and write into the donated pool."""
+        nb = len(blocks)
+        ids = np.zeros(self.cfg.kv_pages, np.int32)
+        ids[:nb] = blocks
+        padded = []
+        for a in arrays:
+            axis = a.ndim - 4
+            pad = [(0, 0)] * a.ndim
+            pad[axis] = (0, self.cfg.kv_pages - a.shape[axis])
+            padded.append(jnp.asarray(np.pad(a, pad)))
+        with self._mesh_ctx():
+            self._cache = self._aot_call(
+                "kv_block_scatter", kv_block_scatter, (),
+                (self._cache, jnp.asarray(ids), padded), {},
+                donation="cache")
+
+    def export_kv_blocks(self, req: Request) -> KVBlockPayload:
+        """Gather a PARKED request's KV blocks off the pool into a
+        host payload and release its slot — the prefill-role half of a
+        disaggregated handoff. The payload carries the prompt, the
+        delivered first token (in ``generated``), the sampling
+        contract and the exact K/V of [0, true_len), so the importing
+        engine continues the stream bitwise as if it had prefilled
+        locally. After export this engine holds NOTHING for the
+        request (radix-cached prefix blocks live on through the
+        cache's own reference)."""
+        if not self.paged:
+            raise ValueError("export_kv_blocks requires the paged engine")
+        rec = self._prefilled.pop(req.id, None)
+        if rec is None:
+            raise ValueError(
+                f"request {req.id} is not parked for export")
+        slot, true_len = rec["slot"], rec["length"]
+        nb = -(-true_len // self.block_size)
+        payload = KVBlockPayload(
+            prompt=req.prompt.copy(), generated=list(req.new_tokens),
+            true_len=true_len, block_size=self.block_size,
+            max_new_tokens=req.max_new_tokens, sampling=req.sampling,
+            stop_ids=tuple(req.stop_ids),
+            leaves=self._gather_blocks(self._slot_blocks[slot][:nb]))
+        self._release_slot(slot)
+        req.slot = None
+        req.parked = False
+        st = self._stats
+        st["kv_exports"] += 1
+        st["kv_exported_blocks"] += nb
+        st["kv_stream_bytes"] += payload.nbytes
+        return payload
+
+    def import_kv_blocks(self, payload: KVBlockPayload, *,
+                         on_token=None,
+                         deadline_s: float | None = None
+                         ) -> Request | None:
+        """Scatter a KVBlockPayload into free pool blocks and ACTIVATE
+        the stream mid-flight — the decode-role half. Returns the live
+        Request handle (its ``new_tokens`` is pre-seeded with the
+        exporter's delivered tokens; ``resumed_from`` guards
+        re-delivery exactly like submit(generated=...)), or None on a
+        resource shortfall (no free slot / pool blocks) — the caller
+        falls back to resume-from-tokens redispatch, which is lossless
+        by construction. Geometry/model mismatches raise ValueError:
+        importing foreign K/V silently would serve garbage."""
+        if not self.paged:
+            raise ValueError("import_kv_blocks requires the paged engine")
+        if self.spec_k:
+            raise ValueError(
+                "import_kv_blocks does not compose with spec_k > 0 "
+                "(the draft pool is not on the KV stream)")
+        if payload.block_size != self.block_size:
+            raise ValueError(
+                f"payload block_size {payload.block_size} != engine "
+                f"block_size {self.block_size}")
+        if not payload.generated:
+            raise ValueError(
+                "payload carries no generated tokens — the exporter "
+                "always delivers the first token before parking")
+        if payload.true_len != payload.prompt.size + len(
+                payload.generated) - 1:
+            raise ValueError(
+                f"payload true_len {payload.true_len} != prompt "
+                f"{payload.prompt.size} + generated "
+                f"{len(payload.generated)} - 1")
+        if payload.prompt.size + payload.max_new_tokens > \
+                self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt_len {payload.prompt.size} + max_new_tokens "
+                f"{payload.max_new_tokens} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        names = self._pool_leaf_names()
+        if [n for n, _ in payload.leaves] != names:
+            raise ValueError(
+                "payload pool leaves do not match this engine's pool "
+                "(different model or layer stacking)")
+        if not self._free:
+            return None
+        nb = payload.num_blocks
+        blocks = self._alloc_blocks(nb)
+        if blocks is None:
+            return None
+        self._scatter_blocks(blocks, [a for _, a in payload.leaves])
+        req = Request(payload.prompt, payload.max_new_tokens,
+                      payload.sampling, tuple(payload.stop_ids),
+                      on_token, deadline_s=deadline_s,
+                      generated=payload.generated)
+        req.submit_time = time.perf_counter()
+        # the exporter timed the real TTFT; this engine's EMA must not
+        # absorb a handoff as a near-zero first token
+        req.first_token_time = req.submit_time
+        slot = self._free.pop()
+        req.slot = slot
+        self._slot_blocks[slot] = list(blocks)
+        self._tables[slot, :] = 0
+        self._tables[slot, :nb] = blocks
+        self._lengths[slot] = payload.true_len
+        self._active[slot] = req
+        self._admit_order[slot] = next(self._admit_seq)
+        self._key_data[slot] = np.asarray(jax.random.key_data(
+            jax.random.key(payload.sampling.seed)))
+        # the activation invariants, verbatim: token n samples with
+        # fold_in(key, n), the next tick's input is the last delivered
+        # token, and the next write position is true_len (backed by
+        # _grow_slots exactly like a local activation — when true_len
+        # is a block multiple the write lands in a FRESH block, never
+        # in an imported/radix-shared one)
+        self._counts[slot] = len(payload.generated)
+        self._tokens[slot] = payload.generated[-1]
+        self._temps[slot] = payload.sampling.temperature
+        self._top_ks[slot] = payload.sampling.top_k
+        self._top_ps[slot] = payload.sampling.top_p
+        if self._radix is not None:
+            full = np.concatenate(
+                [payload.prompt,
+                 np.asarray(payload.generated[:-1], np.int32)])
+            nbf = payload.true_len // self.block_size
+            if nbf:
+                self._radix.insert(full[:nbf * self.block_size],
+                                   blocks[:nbf])
+        st = self._stats
+        st["kv_imports"] += 1
+        st["kv_imported_blocks"] += nb
+        st["kv_stream_bytes"] += payload.nbytes
+        return req
+
+    def export_prefix_blocks(self, tokens) -> PrefixBlockPayload | None:
+        """Gather the radix-cached prefix of ``tokens`` for fleet
+        shipping (the remote-hit path: this replica owns the longest
+        match, another replica is about to prefill it from scratch).
+        None when nothing is cached."""
+        if not self.paged or self._radix is None:
+            return None
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        nodes = self._radix.match_nodes(tokens)
+        if not nodes:
+            return None
+        blocks = [n.block for n in nodes]
+        payload = PrefixBlockPayload(
+            tokens=tokens[:len(blocks) * self.block_size].copy(),
+            block_size=self.block_size,
+            leaves=self._gather_blocks(blocks))
+        self._stats["kv_stream_bytes"] += payload.nbytes
+        return payload
+
+    def import_prefix_blocks(self, payload: PrefixBlockPayload) -> int:
+        """Adopt a fleet-shipped prefix into the local pool + radix as
+        REMOTE entries (steered hits on them count separately from
+        local ones). Best-effort by design — returns the number of
+        blocks adopted, 0 on any mismatch or pool pressure: a failed
+        ship just means this replica prefills the prefix itself."""
+        if (not self.paged or self._radix is None or self.spec_k
+                or payload.block_size != self.block_size
+                or [n for n, _ in payload.leaves]
+                != self._pool_leaf_names()):
+            return 0
+        tokens = np.asarray(payload.tokens, np.int32).reshape(-1)
+        nb = len(tokens) // self.block_size
+        matched = self._radix.match(tokens)
+        m = len(matched)
+        if m >= nb:
+            return 0  # already holds the whole prefix
+        fresh = self._alloc_blocks(nb - m)
+        if fresh is None:
+            return 0
+        suffix = [np.take(a, np.arange(m, nb), axis=a.ndim - 4)
+                  for _, a in payload.leaves]
+        self._scatter_blocks(fresh, suffix)
+        self._radix.insert(tokens[:nb * self.block_size],
+                           matched + fresh, remote=True)
+        for b in fresh:  # the radix reference is now the sole owner
+            self._alloc.decref(b)
+        st = self._stats
+        st["kv_imported_blocks"] += nb - m
+        st["kv_stream_bytes"] += payload.nbytes
+        return nb - m
+
+    def warmup_kv_stream(self) -> None:
+        """Compile the KV stream's two programs with one empty-blocks
+        roundtrip mirroring the real export→host→import data path, so
+        the first real handoff performs zero compiles (the disagg A/B's
+        tripwire). Call AFTER warmup(): the gather must see the
+        steady-state (committed) pool. No-op on the dense engine."""
+        if not self.paged:
+            return
+        leaves = self._gather_blocks([])
+        self._scatter_blocks([], [a for _, a in leaves])
 
     def _expire_deadlines(self) -> int:
         """Retire every request past its ``deadline_s`` — still queued
@@ -1263,6 +1718,16 @@ class ServingEngine:
             pf, self._prefilling = self._prefilling, None
             self._release_slot(pf["slot"])
             out.append(pf["req"])
+        if self.paged and self._prefilled:
+            # parked handoffs: release blocks before retiring (a parked
+            # req's slot is NOT in _active — clear req.slot first so
+            # _retire doesn't try to release it a second way)
+            for rec in [self._prefilled.pop(k)
+                        for k in list(self._prefilled)]:
+                self._release_slot(rec["slot"])
+                rec["req"].slot = None
+                rec["req"].parked = False
+                out.append(rec["req"])
         while self._queue:
             out.append(self._queue.popleft())
         out.extend(self._active.values())
@@ -1504,7 +1969,7 @@ class ServingEngine:
         free_frac = 1.0
         if self.paged:
             free_frac = self._alloc.free_count / max(1, self._alloc.usable)
-        return {
+        out = {
             "alive": True,
             "progress": self._progress,
             "active": len(self._active),
@@ -1517,6 +1982,18 @@ class ServingEngine:
             "ttft_ema_s": self._ttft_ema,
             "sick": self._sick,
         }
+        if self.paged:
+            # the disagg signals (ISSUE 12): parked handoffs awaiting
+            # export, the pool geometry a router needs to hash prompts
+            # for fleet prefix steering, this replica's published
+            # block-hash frontier, and the cross-replica hit counters
+            out["parked"] = len(self._prefilled)
+            out["block_size"] = self.block_size
+            out["remote_hit_tokens"] = self._stats["remote_hit_tokens"]
+            out["admitted_tokens"] = self._stats["admitted_tokens"]
+            if self._radix is not None:
+                out["prefix_frontier"] = self._radix.frontier()
+        return out
 
     def check_params_finite(self) -> bool:
         """Run the compiled params-finite probe (one scalar sync) and
@@ -1557,6 +2034,11 @@ class ServingEngine:
                            admissions=0, admitted_tokens=0,
                            prefix_hit_tokens=0, prefill_chunks=0,
                            preemptions=0, block_used_sum=0.0,
+                           # disaggregation counters (ISSUE 12; stay 0
+                           # colocated)
+                           remote_hit_tokens=0, kv_exports=0,
+                           kv_imports=0, kv_exported_blocks=0,
+                           kv_imported_blocks=0, kv_stream_bytes=0,
                            # speculative counters (stay 0 when spec off)
                            draft_tokens=0, accepted_tokens=0,
                            target_forwards=0)
@@ -1612,10 +2094,25 @@ class ServingEngine:
             out["block_utilization"] = (
                 round(st["block_used_sum"] / st["ticks"], 4)
                 if st["ticks"] else None)
+            # prefix_hit_rate stays LOCAL-only (comparable to
+            # single-engine runs); fleet-shipped prefix hits report as
+            # cross_replica_hit_rate — the steering win, priced apart
             out["prefix_hit_rate"] = (
-                round(st["prefix_hit_tokens"] / st["admitted_tokens"], 4)
+                round((st["prefix_hit_tokens"]
+                       - st["remote_hit_tokens"])
+                      / st["admitted_tokens"], 4)
                 if st["admitted_tokens"] else None)
             out["prefix_hit_tokens"] = st["prefix_hit_tokens"]
+            out["remote_hit_tokens"] = st["remote_hit_tokens"]
+            out["admitted_tokens"] = st["admitted_tokens"]
+            out["cross_replica_hit_rate"] = (
+                round(st["remote_hit_tokens"] / st["admitted_tokens"], 4)
+                if st["admitted_tokens"] else None)
+            out["kv_exports"] = st["kv_exports"]
+            out["kv_imports"] = st["kv_imports"]
+            out["kv_exported_blocks"] = st["kv_exported_blocks"]
+            out["kv_imported_blocks"] = st["kv_imported_blocks"]
+            out["kv_stream_bytes"] = st["kv_stream_bytes"]
             if self._radix is not None:
                 out["prefix_cache"] = self._radix.stats()
         if self.spec_k:

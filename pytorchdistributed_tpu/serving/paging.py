@@ -32,7 +32,28 @@ free list or accounted to at least one live reference.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+
+
+def block_hashes(tokens, block_size: int) -> list[str]:
+    """Chained per-block content digests of ``tokens``'s full blocks:
+    ``h[i] = blake2b(h[i-1] || tokens_of_block_i)``. Each digest names a
+    whole PREFIX (not just its last block), so two replicas hold the
+    same cached prefix iff they hold the same digest — the fleet prefix
+    index's matching unit. blake2b, not Python's ``hash()``: the
+    builtin is per-process salted (PYTHONHASHSEED), and these digests
+    must agree between the router and its subprocess workers."""
+    out: list[str] = []
+    prev = b""
+    for i in range(len(tokens) // block_size):
+        blk = ",".join(
+            str(int(t))
+            for t in tokens[i * block_size:(i + 1) * block_size])
+        prev = hashlib.blake2b(prev + blk.encode(),
+                               digest_size=16).digest()
+        out.append(prev.hex())
+    return out
 
 
 class BlockAllocator:
@@ -123,14 +144,22 @@ class BlockAllocator:
 
 
 class _RadixNode:
-    __slots__ = ("children", "parent", "key", "block", "last_use")
+    __slots__ = ("children", "parent", "key", "block", "last_use",
+                 "hash", "remote")
 
-    def __init__(self, parent, key, block):
+    def __init__(self, parent, key, block, hash="", remote=False):
         self.children: dict[tuple, _RadixNode] = {}
         self.parent = parent
         self.key = key
         self.block = block
         self.last_use = 0
+        # the node's chained prefix digest (block_hashes) — what the
+        # replica publishes in its health frontier
+        self.hash = hash
+        # True when the block's K/V arrived over the fleet KV stream
+        # (import_prefix_blocks) instead of local prefill — hits through
+        # it are STEERED hits, counted separately from local ones
+        self.remote = remote
 
 
 class RadixPrefixCache:
@@ -144,11 +173,16 @@ class RadixPrefixCache:
         self._root = _RadixNode(None, None, None)
         self._clock = itertools.count(1)
         self._nodes = 0
-        # admission-level counters the engine folds into its summary
+        # admission-level counters the engine folds into its summary.
+        # hit_tokens counts LOCAL hits only; steered hits (through
+        # remote-imported blocks) land in remote_hit_tokens — keeping
+        # hit_rate/token_hit_rate comparable to the pre-fleet stamps
         self.lookups = 0
         self.hits = 0
         self.lookup_tokens = 0
         self.hit_tokens = 0
+        self.remote_hits = 0
+        self.remote_hit_tokens = 0
         self.evictions = 0
 
     @property
@@ -169,44 +203,77 @@ class RadixPrefixCache:
         re-matches every retry; the engine records ONE
         ``record_admission`` when the admission actually lands).
         Touches the walked nodes' LRU clocks."""
+        return [n.block for n in self.match_nodes(tokens)]
+
+    def match_nodes(self, tokens) -> list:
+        """Like match(), but returns the NODES — callers that need the
+        remote flag (steered-hit accounting) or the prefix digests read
+        them off the chain."""
         node, out = self._root, []
         for key in self._keys(tokens):
             child = node.children.get(key)
             if child is None:
                 break
             child.last_use = next(self._clock)
-            out.append(child.block)
+            out.append(child)
             node = child
         return out
 
-    def record_admission(self, matched_blocks: int,
-                         lookup_tokens: int) -> None:
-        """Fold one LANDED admission into the hit-rate counters."""
+    def record_admission(self, matched_blocks: int, lookup_tokens: int,
+                         remote_blocks: int = 0) -> None:
+        """Fold one LANDED admission into the hit-rate counters.
+        ``remote_blocks`` (of the matched) came from fleet-shipped
+        prefix imports — they count as STEERED hits, kept out of the
+        local hit_rate so it stays comparable across fleet topologies."""
         self.lookups += 1
         self.lookup_tokens += lookup_tokens
-        if matched_blocks:
+        local = matched_blocks - remote_blocks
+        if local:
             self.hits += 1
-            self.hit_tokens += matched_blocks * self.alloc.block_size
+            self.hit_tokens += local * self.alloc.block_size
+        if remote_blocks:
+            self.remote_hits += 1
+            self.remote_hit_tokens += remote_blocks * self.alloc.block_size
 
-    def insert(self, tokens, blocks) -> int:
+    def insert(self, tokens, blocks, remote: bool = False) -> int:
         """Register ``blocks`` as the cache entries for the full-block
         prefix of ``tokens`` (``len(blocks)`` blocks' worth). Prefix
         nodes that already exist keep their block (the caller was
         admitted THROUGH them, so blocks[i] is the same physical id);
-        new nodes take one allocator reference each. Returns how many
-        new blocks were cached."""
+        new nodes take one allocator reference each and are stamped
+        ``remote`` when their K/V arrived over the fleet KV stream.
+        Returns how many new blocks were cached."""
+        hashes = block_hashes(tokens, self.alloc.block_size)
         node, added = self._root, 0
-        for key, block in zip(self._keys(tokens), blocks):
+        for key, block, hsh in zip(self._keys(tokens), blocks, hashes):
             child = node.children.get(key)
             if child is None:
                 self.alloc.incref(block)
-                child = _RadixNode(node, key, block)
+                child = _RadixNode(node, key, block, hash=hsh,
+                                   remote=remote)
                 node.children[key] = child
                 self._nodes += 1
                 added += 1
             child.last_use = next(self._clock)
             node = child
         return added
+
+    def frontier(self, limit: int = 64) -> list[str]:
+        """The most-recently-used ``limit`` cached prefix digests — what
+        health() publishes for the router's FleetPrefixIndex. Every
+        cached node's digest is a candidate (an internal node is a
+        valid shorter match for a prompt that diverges below it);
+        recency-bounded so a subprocess replica's health row stays a
+        small JSON line, and hot prefixes (the ones worth steering
+        toward) survive the bound."""
+        nodes: list[_RadixNode] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            stack.extend(n.children.values())
+        nodes.sort(key=lambda n: n.last_use, reverse=True)
+        return [n.hash for n in nodes[:limit]]
 
     def _evictable_leaves(self) -> list[_RadixNode]:
         out = []
@@ -285,6 +352,7 @@ class RadixPrefixCache:
         content and LRU state are untouched."""
         self.lookups = self.hits = 0
         self.lookup_tokens = self.hit_tokens = 0
+        self.remote_hits = self.remote_hit_tokens = 0
         self.evictions = 0
 
     def stats(self) -> dict:
@@ -298,6 +366,76 @@ class RadixPrefixCache:
             "token_hit_rate": (
                 round(self.hit_tokens / self.lookup_tokens, 4)
                 if self.lookup_tokens else None),
+            # steered hits (fleet-shipped prefix blocks) — split out so
+            # hit_rate above stays the LOCAL rate, comparable to the
+            # per-engine stamps from before the fleet index existed
+            "remote_hits": self.remote_hits,
+            "remote_hit_tokens": self.remote_hit_tokens,
+            "remote_token_hit_rate": (
+                round(self.remote_hit_tokens / self.lookup_tokens, 4)
+                if self.lookup_tokens else None),
             "cached_blocks": self._nodes,
             "evictions": self.evictions,
         }
+
+
+class FleetPrefixIndex:
+    """The router-owned fleet-wide view of every replica's radix
+    frontier (the tentpole's cross-replica half): each replica publishes
+    its cached prefix digests (``RadixPrefixCache.frontier()``) through
+    ``health()`` snapshots; the dispatcher asks this index which replica
+    holds the LONGEST cached prefix of an incoming prompt's digest chain
+    (``block_hashes``) and steers the request there — or, when the owner
+    can't take it, ships the matched blocks over the KV stream so a hot
+    system prompt is prefilled once per fleet, not once per replica.
+    Pure host state; refreshed (not accumulated) per snapshot, so a
+    replica's evictions and deaths age out of the index naturally."""
+
+    def __init__(self):
+        self._frontiers: dict[int, set[str]] = {}
+
+    def update(self, replica: int, hashes) -> None:
+        """Replace ``replica``'s published frontier with this snapshot's."""
+        self._frontiers[replica] = set(hashes or ())
+
+    def add(self, replica: int, hashes) -> None:
+        """Extend ``replica``'s frontier in place — the router's
+        optimistic bookkeeping right after a prefix ship, so a burst of
+        same-prefix arrivals doesn't re-ship the same blocks every
+        dispatch until the next health snapshot replaces the set."""
+        self._frontiers.setdefault(replica, set()).update(hashes or ())
+
+    def remove(self, replica: int) -> None:
+        self._frontiers.pop(replica, None)
+
+    def match_depth(self, replica: int, hash_chain) -> int:
+        """Longest prefix (in blocks) of ``hash_chain`` this replica
+        published. Digests are chained, so membership of ``chain[i]``
+        alone proves the whole i+1-block prefix is cached there."""
+        have = self._frontiers.get(replica)
+        if not have:
+            return 0
+        depth = 0
+        for h in hash_chain:
+            if h not in have:
+                break
+            depth += 1
+        return depth
+
+    def best_match(self, hash_chain, eligible=None) -> tuple[int | None,
+                                                             int]:
+        """(replica, depth) of the deepest published match — the
+        steering target. ``eligible`` restricts candidates; ties break
+        toward the lowest replica index (deterministic). (None, 0) when
+        nobody holds any prefix of the chain."""
+        best, best_depth = None, 0
+        for rep in sorted(self._frontiers):
+            if eligible is not None and rep not in eligible:
+                continue
+            d = self.match_depth(rep, hash_chain)
+            if d > best_depth:
+                best, best_depth = rep, d
+        return best, best_depth
+
+    def replicas(self) -> list[int]:
+        return sorted(self._frontiers)

@@ -215,9 +215,16 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
         kind = op.get("op")
         if kind == "warmup":
             engine.warmup(prompt_lens=op.get("prompt_lens") or None)
+            if op.get("kv_stream"):
+                # compile the KV gather/scatter pair now so the first
+                # real handoff/prefix ship is dispatch-only
+                engine.warmup_kv_stream()
             # report the real context bound so the router can validate
-            # submits against it instead of trusting the spec
-            reply(ok=True, max_seq_len=engine.cfg.max_seq_len)
+            # submits against it instead of trusting the spec — and a
+            # first health snapshot, so role decisions (block_size,
+            # free_slots) don't wait for the first step reply
+            reply(ok=True, max_seq_len=engine.cfg.max_seq_len,
+                  health=engine.health())
         elif kind == "submit":
             s = op.get("sampling", {})
             from pytorchdistributed_tpu.serving.engine import (
@@ -234,7 +241,8 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                     stop_ids=tuple(op.get("stop_ids") or ()),
                     deadline_s=op.get("deadline_s"),
                     generated=op.get("generated") or None,
-                    on_token=on_token)
+                    on_token=on_token,
+                    prefill_only=bool(op.get("prefill_only")))
             except ValueError as e:
                 # a malformed request must cost ONE refusal, not the
                 # worker process (and then, replica by replica, the
@@ -268,10 +276,68 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
             if heartbeat is not None:
                 heartbeat.beat()  # after the engine's host sync
             reply(ok=True, delivered=list(delivered),
-                  finished=list(finished), health=engine.health())
+                  finished=list(finished), health=engine.health(),
+                  parked=[r.router_rid for r in engine.parked_requests
+                          if hasattr(r, "router_rid")])
             # clear IN PLACE: on_token/sweep_finished close over these
             delivered.clear()
             finished.clear()
+        elif kind == "export_kv":
+            from pytorchdistributed_tpu.serving.engine import (
+                kv_payload_to_wire,
+            )
+
+            req = reqs.get(op["rid"])
+            if req is None:
+                reply(ok=False, error=f"unknown rid {op['rid']}")
+                continue
+            try:
+                payload = engine.export_kv_blocks(req)
+            except ValueError as e:
+                reply(ok=False, error=str(e))
+                continue
+            del reqs[op["rid"]]  # the stream now lives in the payload
+            reply(ok=True, rid=op["rid"],
+                  payload=kv_payload_to_wire(payload))
+        elif kind == "import_kv":
+            from pytorchdistributed_tpu.serving.engine import (
+                kv_payload_from_wire,
+            )
+
+            try:
+                req = engine.import_kv_blocks(
+                    kv_payload_from_wire(op["payload"]),
+                    on_token=on_token, deadline_s=op.get("deadline_s"))
+            except ValueError as e:
+                reply(ok=False, error=str(e))
+                continue
+            if req is None:   # pool pressure: refuse, router requeues
+                reply(ok=False, error="no free slot/blocks")
+                continue
+            req.router_rid = op["rid"]
+            reqs[op["rid"]] = req
+            reply(ok=True, rid=op["rid"])
+        elif kind == "export_prefix":
+            import numpy as np
+
+            from pytorchdistributed_tpu.serving.engine import (
+                prefix_payload_to_wire,
+            )
+
+            payload = engine.export_prefix_blocks(
+                np.asarray(op["tokens"], np.int32))
+            if payload is None:
+                reply(ok=False)
+            else:
+                reply(ok=True, payload=prefix_payload_to_wire(payload))
+        elif kind == "import_prefix":
+            from pytorchdistributed_tpu.serving.engine import (
+                prefix_payload_from_wire,
+            )
+
+            adopted = engine.import_prefix_blocks(
+                prefix_payload_from_wire(op["payload"]))
+            reply(ok=True, adopted=int(adopted))
         elif kind == "probe":
             reply(finite=engine.check_params_finite())
         elif kind == "drain":

@@ -78,6 +78,14 @@ from pytorchdistributed_tpu.faults.retry import RetryPolicy
 from pytorchdistributed_tpu.serving.engine import (
     SamplingParams,
     ServingEngine,
+    kv_payload_from_wire,
+    kv_payload_to_wire,
+    prefix_payload_from_wire,
+    prefix_payload_to_wire,
+)
+from pytorchdistributed_tpu.serving.paging import (
+    FleetPrefixIndex,
+    block_hashes,
 )
 from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
 
@@ -86,6 +94,17 @@ from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
 #: after a clean streak + canary; DEAD is crashed or hung (its requests
 #: were failed over) and never returns.
 HEALTHY, QUARANTINED, DEAD = "healthy", "quarantined", "dead"
+
+#: Replica roles (ISSUE 12 — prefill/decode disaggregation). A
+#: ``prefill``-role replica runs chunked prefill only: its requests are
+#: submitted ``prefill_only`` and PARK after the first token, then the
+#: router's handoff sweep streams their KV blocks to a decode-capable
+#: replica which activates the stream mid-flight. ``decode`` replicas
+#: receive handoffs (and serve full requests only as a fallback when no
+#: prefill-capable replica is healthy — availability beats role
+#: purity). ``both`` (the default) is the colocated PR-9 behavior.
+ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH = "prefill", "decode", "both"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH)
 
 #: Default redispatch backoff: immediate-ish (serving latency budgets are
 #: milliseconds, not checkpoint-restore seconds), but still exponential
@@ -142,6 +161,7 @@ class RouterRequest:
         self._eligible_at = 0.0              # redispatch backoff gate
         self._handle = None                  # engine-side request/mirror
         self._replica: int | None = None
+        self._hash_chain: list[str] | None = None  # fleet prefix index
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -186,15 +206,37 @@ class InProcessReplica:
         self._hung = False
         self._crash_next = False
 
-    def warmup(self, prompt_lens=None) -> None:
+    def warmup(self, prompt_lens=None, kv_stream: bool = True) -> None:
         self.engine.warmup(prompt_lens=prompt_lens or self.warmup_lens)
+        if kv_stream:
+            # the KV stream's gather/scatter pair (no-op dense): warmed
+            # unconditionally so a handoff or fleet prefix ship never
+            # compiles mid-serving
+            self.engine.warmup_kv_stream()
 
     def submit(self, rr: RouterRequest, *, generated, deadline_s,
-               on_token):
+               on_token, prefill_only: bool = False):
         return self.engine.submit(
             rr.prompt, max_new_tokens=rr.max_new_tokens,
             sampling=rr.sampling, stop_ids=rr.stop_ids,
-            deadline_s=deadline_s, generated=generated, on_token=on_token)
+            deadline_s=deadline_s, generated=generated, on_token=on_token,
+            prefill_only=prefill_only)
+
+    # -- KV block stream (ISSUE 12) -----------------------------------
+
+    def export_kv(self, rr: RouterRequest):
+        return self.engine.export_kv_blocks(rr._handle)
+
+    def import_kv(self, rr: RouterRequest, payload, *, deadline_s,
+                  on_token):
+        return self.engine.import_kv_blocks(
+            payload, on_token=on_token, deadline_s=deadline_s)
+
+    def export_prefix(self, tokens):
+        return self.engine.export_prefix_blocks(tokens)
+
+    def import_prefix(self, payload) -> int:
+        return self.engine.import_prefix_blocks(payload)
 
     def step(self) -> None:
         if self._crash_next:
@@ -259,6 +301,17 @@ class InProcessReplica:
     def close(self) -> None:
         if self.alive and not self._hung:
             self.engine.close()
+
+
+class _Mirror:
+    """Router-side stand-in for a request living in a subprocess
+    worker: done/finish_reason arrive in step replies; ``parked``
+    flips when the worker reports the request prefilled-and-parked
+    (the handoff sweep's trigger)."""
+
+    done = False
+    finish_reason = None
+    parked = False
 
 
 class SubprocessReplica:
@@ -386,14 +439,16 @@ class SubprocessReplica:
 
     # -- replica protocol ---------------------------------------------
 
-    def warmup(self, prompt_lens=None) -> None:
+    def warmup(self, prompt_lens=None, kv_stream: bool = True) -> None:
         self._send({"op": "warmup",
-                    "prompt_lens": list(prompt_lens or [])})
+                    "prompt_lens": list(prompt_lens or []),
+                    "kv_stream": bool(kv_stream)})
         # first warmup pays the worker's jax import + compiles; the
         # reply carries the engine's real max_seq_len
         self._consume(self.wait_response(timeout=600.0))
 
-    def warmup_async(self, prompt_lens=None) -> None:
+    def warmup_async(self, prompt_lens=None, kv_stream: bool = True
+                     ) -> None:
         """Send the warmup op WITHOUT waiting — the respawn path
         (ISSUE 10): a replacement worker's startup (jax import +
         checkpoint restore + cached warmup) must not stall the router's
@@ -402,10 +457,11 @@ class SubprocessReplica:
         is consumed by the probe path's receive whenever it lands."""
         self._warming = True
         self._send({"op": "warmup",
-                    "prompt_lens": list(prompt_lens or [])})
+                    "prompt_lens": list(prompt_lens or []),
+                    "kv_stream": bool(kv_stream)})
 
     def submit(self, rr: RouterRequest, *, generated, deadline_s,
-               on_token):
+               on_token, prefill_only: bool = False):
         self._drain_wire()
         self._send({"op": "submit", "rid": rr.id,
                     "prompt": rr.prompt.tolist(),
@@ -417,15 +473,61 @@ class SubprocessReplica:
                         "seed": rr.sampling.seed},
                     "stop_ids": list(rr.stop_ids),
                     "generated": list(generated or []),
-                    "deadline_s": deadline_s})
+                    "deadline_s": deadline_s,
+                    "prefill_only": bool(prefill_only)})
         self._on_token[rr.id] = on_token
-
-        class _Mirror:
-            done = False
-            finish_reason = None
         m = _Mirror()
         self._mirrors[rr.id] = m
         return m
+
+    # -- KV block stream (ISSUE 12) -----------------------------------
+    # Handoffs are synchronous wire roundtrips by design: the payload
+    # op and its reply must not interleave with step traffic (the
+    # one-in-flight invariant), and a handoff is rare relative to
+    # ticks. A wedged worker surfaces as TimeoutError — the caller's
+    # dead-replica path, same as submit.
+
+    def export_kv(self, rr: RouterRequest):
+        self._drain_wire()
+        self._send({"op": "export_kv", "rid": rr.id})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        if resp.get("ok") is not True or not resp.get("payload"):
+            raise ValueError(
+                f"replica {self.index}: export_kv({rr.id}) refused: "
+                f"{resp.get('error')}")
+        self._mirrors.pop(rr.id, None)
+        self._on_token.pop(rr.id, None)
+        return kv_payload_from_wire(resp["payload"])
+
+    def import_kv(self, rr: RouterRequest, payload, *, deadline_s,
+                  on_token):
+        self._drain_wire()
+        self._send({"op": "import_kv", "rid": rr.id,
+                    "deadline_s": deadline_s,
+                    "payload": kv_payload_to_wire(payload)})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        if resp.get("ok") is not True:
+            return None  # no capacity / mismatch: resume-from-tokens
+        m = _Mirror()
+        self._mirrors[rr.id] = m
+        self._on_token[rr.id] = on_token
+        return m
+
+    def export_prefix(self, tokens):
+        self._drain_wire()
+        self._send({"op": "export_prefix",
+                    "tokens": [int(t) for t in tokens]})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        if resp.get("ok") is not True or not resp.get("payload"):
+            return None
+        return prefix_payload_from_wire(resp["payload"])
+
+    def import_prefix(self, payload) -> int:
+        self._drain_wire()
+        self._send({"op": "import_prefix",
+                    "payload": prefix_payload_to_wire(payload)})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        return int(resp.get("adopted", 0)) if resp.get("ok") else 0
 
     def _drain_wire(self, timeout: float | None = None) -> None:
         """Consume the pending response (if any) before sending a new
@@ -460,6 +562,10 @@ class SubprocessReplica:
             cb = self._on_token.get(rid)
             if cb is not None:
                 cb(rid, tok)
+        for rid in resp.get("parked", []):
+            m = self._mirrors.get(rid)
+            if m is not None:
+                m.parked = True
         for rid, reason in resp.get("finished", []):
             m = self._mirrors.pop(rid, None)
             if m is not None:
@@ -570,6 +676,20 @@ class ReplicaRouter:
         worker is launched under the run.py env contract.
 
     Knobs:
+      roles: one of ROLE_PREFILL / ROLE_DECODE / ROLE_BOTH per replica
+        (ISSUE 12) — None means all ``both`` (the colocated default).
+        With any split role, new requests dispatch to prefill-capable
+        replicas as ``prefill_only`` admissions; the handoff sweep
+        streams each parked request's KV blocks to the decode-capable
+        replica the health scorer picks, which activates the stream
+        mid-flight (bitwise-equal to colocated — the blocks carry
+        exact K/V). Any handoff failure falls back to resume-from-
+        tokens redispatch, so disaggregation can only cost a re-
+        prefill, never a stream. Independently of roles, the router
+        keeps a fleet-wide prefix index over every replica's published
+        radix frontier: the dispatcher steers prefix-sharing requests
+        to the deepest match, shipping the owner's cached blocks to
+        the chosen replica when they differ.
       max_queue: router admission bound — a submit arriving with this
         many requests already queued is SHED immediately
         (``finish_reason="shed"``): bounded latency for everyone
@@ -618,7 +738,7 @@ class ReplicaRouter:
 
     def __init__(self, model=None, params=None, *, replicas: int = 2,
                  engine_kwargs: dict | None = None, factories=None,
-                 workers=None, warmup_lens=None,
+                 workers=None, warmup_lens=None, roles=None,
                  max_queue: int | None = None, max_retries: int = 2,
                  retry_policy: RetryPolicy = ROUTER_RETRY,
                  hang_ticks: int = 8, health_every: int = 4,
@@ -691,6 +811,28 @@ class ReplicaRouter:
                 r.engine.cfg.max_seq_len for r in self._replicas)
         if not self._replicas:
             raise ValueError("need at least one replica")
+        if roles is None:
+            roles = [ROLE_BOTH] * len(self._replicas)
+        roles = list(roles)
+        if len(roles) != len(self._replicas):
+            raise ValueError(
+                f"roles has {len(roles)} entries for "
+                f"{len(self._replicas)} replicas")
+        for role in roles:
+            if role not in ROLES:
+                raise ValueError(f"unknown role {role!r} (want one of "
+                                 f"{ROLES})")
+        self._roles = roles
+        self._disagg = any(role != ROLE_BOTH for role in roles)
+        if self._disagg and not any(
+                role in (ROLE_DECODE, ROLE_BOTH) for role in roles):
+            raise ValueError(
+                "a disaggregated topology needs at least one decode-"
+                "capable replica (role 'decode' or 'both') to receive "
+                "KV handoffs")
+        # the fleet-wide prefix index (ISSUE 12): every replica's
+        # published radix frontier, refreshed from health snapshots
+        self._prefix_index = FleetPrefixIndex()
         self.max_queue = max_queue
         self.max_retries = max_retries
         self.retry_policy = retry_policy
@@ -835,6 +977,9 @@ class ReplicaRouter:
                 r.step()
             except ReplicaCrashed:
                 self._declare_dead(r, "crashed")
+        # 4b. sweep parked prefill-role admissions onto decode-capable
+        # replicas over the KV stream (ISSUE 12)
+        self._handoffs()
         # 5. reap
         self._reap()
         self._expire_queued_deadlines()
@@ -845,7 +990,9 @@ class ReplicaRouter:
                 self.telemetry.replica(
                     tick=self._ticks, replica=r.index,
                     status=self._status[r.index],
+                    role=self._roles[r.index],
                     active=h.get("active", 0), queued=h.get("queued", 0),
+                    parked=h.get("parked", 0),
                     occupancy=round(h.get("occupancy", 0.0), 4),
                     progress=h.get("progress", -1))
         return self._step_stats(dispatched)
@@ -869,6 +1016,8 @@ class ReplicaRouter:
                 self._declare_dead(r, "crashed")
                 continue
             self._health[i] = h
+            if "prefix_frontier" in h:
+                self._prefix_index.update(i, h["prefix_frontier"])
             if not h.get("alive", True):
                 self._declare_dead(r, "crashed")
                 continue
@@ -882,7 +1031,16 @@ class ReplicaRouter:
                 # response latency
                 now = time.perf_counter()
                 prog = h.get("progress", -1)
-                if self._assigned[i] and prog == self._last_progress[i]:
+                # a stream parked for KV handoff (or queued behind
+                # parked slots) is waiting on a decode slot, not on this
+                # replica's compiled step — only work the engine has
+                # actually admitted freezes the watermark, or a
+                # saturated decode fleet would get every prefill replica
+                # shot as "hung" while its exports queue
+                working = (h.get("active", 0)
+                           + h.get("prefilling", 0)) > 0
+                if (self._assigned[i] and working
+                        and prog == self._last_progress[i]):
                     self._stale[i] += 1
                 else:
                     self._stale[i] = 0
@@ -927,6 +1085,7 @@ class ReplicaRouter:
         if self._status[r.index] == DEAD:
             return
         self._status[r.index] = DEAD
+        self._prefix_index.remove(r.index)
         self._stats["replicas_lost"] += 1
         if why == "hung":
             self._stats["hangs_detected"] += 1
@@ -1060,6 +1219,7 @@ class ReplicaRouter:
         token it would emit is garbage — then park it out of rotation,
         probing for recovery."""
         self._status[r.index] = QUARANTINED
+        self._prefix_index.remove(r.index)
         self._clean_probes[r.index] = 0
         self._stats["quarantines"] += 1
         self._event("quarantine", replica=r.index)
@@ -1157,12 +1317,71 @@ class ReplicaRouter:
             score += 0.25 * min(ema / mean_ttft, 2.0)
         return score
 
+    def _prefix_chain(self, rr: RouterRequest) -> list[str]:
+        """The request's prompt as a chained block-hash list, computed
+        once and cached on the RouterRequest. Empty when no paged
+        replica has published a block size yet (dense fleet, or first
+        ticks before health snapshots arrive)."""
+        chain = getattr(rr, "_hash_chain", None)
+        if chain is not None:
+            return chain
+        bs = 0
+        for h in self._health:
+            if h.get("block_size"):
+                bs = int(h["block_size"])
+                break
+        if not bs:
+            return []   # not cached: block_size may appear next tick
+        chain = block_hashes(np.asarray(rr.prompt), bs)
+        rr._hash_chain = chain
+        return chain
+
+    def _maybe_ship_prefix(self, rr: RouterRequest, chain: list[str],
+                           best) -> None:
+        """Fleet-wide prefix reuse: if another healthy replica holds a
+        deeper cached match for this prompt than the chosen target, ship
+        the matched blocks over the KV stream so the prefix is prefilled
+        once per fleet, not once per replica. Best-effort — any failure
+        just means the target prefills locally."""
+        eligible = {r.index for r in self._replicas
+                    if self._status[r.index] == HEALTHY}
+        owner, depth = self._prefix_index.best_match(chain,
+                                                     eligible=eligible)
+        if (owner is None or owner == best.index or depth < 1
+                or self._prefix_index.match_depth(best.index,
+                                                  chain) >= depth):
+            return
+        try:
+            payload = self._replicas[owner].export_prefix(
+                np.asarray(rr.prompt))
+            if payload is None:
+                return
+            adopted = best.import_prefix(payload)
+        except (ReplicaCrashed, TimeoutError):
+            return  # health machinery will notice on its own
+        if adopted:
+            self._stats["prefix_ships"] += 1
+            self._stats["kv_stream_bytes"] += payload.nbytes
+            # optimistic: the target now holds these blocks — steer
+            # follow-on siblings there before its next health refresh
+            self._prefix_index.add(best.index, chain[:depth])
+            self._event("prefix_ship", request=rr.id, owner=owner,
+                        target=best.index, blocks=adopted, depth=depth)
+
     def _dispatch(self) -> int:
         healthy = [r for r in self._replicas
                    if self._status[r.index] == HEALTHY]
         if not healthy or not self._queue:
             return 0
-        emas = [self._health[r.index].get("ttft_ema_s") for r in healthy]
+        # disaggregated fleet: new admissions go to prefill-capable
+        # replicas (role prefill/both); if none survive, availability
+        # beats role purity and any healthy replica may admit
+        cands = healthy
+        if self._disagg:
+            pref = [r for r in healthy
+                    if self._roles[r.index] in (ROLE_PREFILL, ROLE_BOTH)]
+            cands = pref or healthy
+        emas = [self._health[r.index].get("ttft_ema_s") for r in cands]
         emas = [e for e in emas if e]
         mean_ttft = sum(emas) / len(emas) if emas else None
         now = time.perf_counter()
@@ -1183,21 +1402,29 @@ class ReplicaRouter:
             # room = the replica can hold it without unbounded queueing;
             # ties break toward the replica with fewer lifetime
             # placements (deterministic round-robin under light load —
-            # a pure index tie-break would starve the higher indices)
+            # a pure index tie-break would starve the higher indices).
+            # A published prefix match dominates the key: landing on the
+            # replica that already holds the blocks skips whole prefill
+            # chunks, which is worth more than any load delta
+            chain = self._prefix_chain(rr)
             best, best_key = None, None
-            for r in healthy:
+            for r in cands:
                 h = self._health[r.index]
                 load = (h.get("active", 0) + h.get("queued", 0)
-                        + h.get("prefilling", 0))
+                        + h.get("prefilling", 0) + h.get("parked", 0))
                 if load >= h.get("num_slots", 1) + self.max_pending:
                     continue
-                key = (self._replica_score(h, mean_ttft),
+                depth = (self._prefix_index.match_depth(r.index, chain)
+                         if chain else 0)
+                key = (-depth, self._replica_score(h, mean_ttft),
                        self._placements[r.index], r.index)
                 if best_key is None or key < best_key:
                     best, best_key = r, key
             if best is None:
                 deferred.append(rr)   # every replica full: wait
                 break
+            if chain and not rr.tokens:
+                self._maybe_ship_prefix(rr, chain, best)
             if not self._place(rr, best):
                 # the pick died at placement (request was requeued);
                 # stop this pass — the next tick re-dispatches against
@@ -1222,9 +1449,21 @@ class ReplicaRouter:
         def cb(_handle, tok, rr=rr, idx=r.index):
             self._on_token(rr, idx, tok)
 
+        # a prefill-role replica parks the stream after its first token
+        # for KV handoff — but only while a decode-capable replica is
+        # alive to receive it; otherwise it decodes in place (lossy
+        # topology never beats a lost stream)
+        prefill_only = (
+            self._disagg
+            and self._roles[r.index] == ROLE_PREFILL
+            and bool(self._health[r.index].get("block_size"))
+            and any(self._status[x.index] == HEALTHY
+                    and self._roles[x.index] in (ROLE_DECODE, ROLE_BOTH)
+                    for x in self._replicas))
         try:
             handle = r.submit(rr, generated=rr.tokens or None,
-                              deadline_s=remaining, on_token=cb)
+                              deadline_s=remaining, on_token=cb,
+                              prefill_only=prefill_only)
         except (ReplicaCrashed, TimeoutError):
             # the pick died (or stopped answering) between health check
             # and placement: requeue the request, let the health
@@ -1270,6 +1509,124 @@ class ReplicaRouter:
                         if rr._handle is not None and rr._handle.done]:
                 rr = assigned.pop(rid)
                 self._finish(rr, rr._handle.finish_reason)
+
+    # -- prefill→decode handoff (ISSUE 12) -----------------------------
+
+    def _handoffs(self) -> None:
+        """Move every stream a prefill-role replica has parked onto a
+        decode-capable replica over the KV stream. Every failure mode
+        degrades to the lossless resume-from-tokens path: the first
+        token was already delivered, so requeueing the RouterRequest
+        replays the prompt + delivered tokens on any survivor."""
+        if not self._disagg:
+            return
+        for src in self._replicas:
+            if (self._status[src.index] != HEALTHY
+                    or self._roles[src.index] != ROLE_PREFILL):
+                continue
+            parked = [rr for rr in self._assigned[src.index].values()
+                      if rr._handle is not None
+                      and getattr(rr._handle, "parked", False)
+                      and not getattr(rr._handle, "done", False)]
+            for rr in parked:
+                self._handoff(rr, src)
+
+    def _handoff(self, rr: RouterRequest, src) -> None:
+        # target FIRST, export second: with no decode-capable home the
+        # stream simply stays parked on src (its blocks intact) and the
+        # sweep retries next tick — exporting eagerly would strand the
+        # KV in a payload and force a full re-prefill via requeue
+        tgt, tgt_key = None, None
+        for r in self._replicas:
+            if (self._status[r.index] != HEALTHY
+                    or r.index == src.index
+                    or self._roles[r.index] not in (ROLE_DECODE,
+                                                    ROLE_BOTH)):
+                continue
+            # LIVE snapshot, not this tick's _check_health copy: the
+            # drain loop runs handoffs without health sweeps, and a
+            # freed decode slot must be visible there too
+            try:
+                h = r.health()
+            except ReplicaCrashed:
+                continue   # the health machinery will take it down
+            if not h.get("free_slots", 0):
+                continue
+            key = (self._replica_score(h, None), self._placements[r.index],
+                   r.index)
+            if tgt_key is None or key < tgt_key:
+                tgt, tgt_key = r, key
+        if tgt is None:
+            return   # parked, not failed: wait for a decode slot
+        try:
+            payload = src.export_kv(rr)
+        except (ReplicaCrashed, TimeoutError):
+            # rr is still in src's assigned map — _declare_dead's
+            # failover requeues it with the rest
+            self._declare_dead(src, "crashed")
+            return
+        except ValueError:
+            # the worker REFUSED the export (e.g. stale parked state
+            # after a respawn): the stream no longer exists there —
+            # requeue and let resume-from-tokens replay it
+            del self._assigned[src.index][rr.id]
+            rr._handle = None
+            rr._replica = None
+            rr._eligible_at = 0.0
+            self._queue.appendleft(rr)
+            self._stats["handoff_failures"] += 1
+            self._event("handoff_failed", request=rr.id,
+                        from_replica=src.index, to_replica=None)
+            return
+        # export released the blocks on src: from here the ONLY copy of
+        # the stream's KV is the payload, and the fallback is resume
+        del self._assigned[src.index][rr.id]
+        rr._handle = None
+        rr._replica = None
+        remaining = None
+        if rr.deadline_s is not None:
+            remaining = max(
+                0.001,
+                rr.deadline_s - (time.perf_counter() - rr.submit_time))
+
+        def cb(_handle, tok, rr=rr, idx=tgt.index):
+            self._on_token(rr, idx, tok)
+
+        handle = None
+        try:
+            handle = tgt.import_kv(rr, payload, deadline_s=remaining,
+                                   on_token=cb)
+        except (ReplicaCrashed, TimeoutError):
+            self._declare_dead(tgt, "crashed")
+            handle = None
+        if handle is None:
+            # the import was refused (pool pressure) or the target died
+            # mid-import: requeue — resume-from-tokens replays losslessly
+            rr._eligible_at = 0.0
+            self._queue.appendleft(rr)
+            self._stats["handoff_failures"] += 1
+            self._event("handoff_failed", request=rr.id,
+                        from_replica=src.index, to_replica=tgt.index)
+            return
+        rr._handle = handle
+        rr._replica = tgt.index
+        rr.replicas.append(tgt.index)
+        self._placements[tgt.index] += 1
+        self._assigned[tgt.index][rr.id] = rr
+        self._health[tgt.index]["free_slots"] = \
+            self._health[tgt.index].get("free_slots", 1) - 1
+        if isinstance(tgt, SubprocessReplica):
+            # its cached snapshot refreshes on the next step reply;
+            # debit it NOW so a same-sweep sibling handoff doesn't
+            # over-commit the slot we just took
+            tgt._health["free_slots"] = max(
+                0, tgt._health.get("free_slots", 1) - 1)
+        nbytes = payload.nbytes
+        self._stats["handoffs"] += 1
+        self._stats["kv_stream_bytes"] += nbytes
+        self._event("handoff", request=rr.id, from_replica=src.index,
+                    to_replica=tgt.index, blocks=payload.num_blocks,
+                    bytes=nbytes)
 
     def _expire_queued_deadlines(self) -> None:
         now = time.perf_counter()
@@ -1393,6 +1750,9 @@ class ReplicaRouter:
                     r.step()
                 except ReplicaCrashed:
                     self._declare_dead(r, "crashed")
+            # parked prefill-role streams can only finish on a decode
+            # home — keep the handoff sweep alive through the drain
+            self._handoffs()
             self._reap()
             max_steps -= 1
         # streams stranded on dead replicas at drain time, plus any a
@@ -1465,6 +1825,8 @@ class ReplicaRouter:
                            redispatched_requests=0, quarantines=0,
                            rejoins=0, hangs_detected=0, replicas_lost=0,
                            respawns=0, respawn_failures=0,
+                           handoffs=0, handoff_failures=0,
+                           prefix_ships=0, kv_stream_bytes=0,
                            served_by={}, ttft_s=[],
                            failover_recovery_ticks=[],
                            failover_recovery_s=[])
@@ -1515,6 +1877,16 @@ class ReplicaRouter:
             "replicas_lost": st["replicas_lost"],
             "respawns": st["respawns"],
             "respawn_failures": st["respawn_failures"],
+            "roles": list(self._roles),
+            "handoffs": st["handoffs"],
+            "handoff_failures": st["handoff_failures"],
+            "prefix_ships": st["prefix_ships"],
+            "kv_stream_bytes": st["kv_stream_bytes"],
+            "cross_replica_hit_rate": (
+                round(sum(h.get("remote_hit_tokens", 0)
+                          for h in self._health)
+                      / max(1, sum(h.get("admitted_tokens", 0)
+                                   for h in self._health)), 4)),
             "served_by": dict(sorted(st["served_by"].items())),
             "replica_occupancy": occ,
             "occupancy_spread": (round(max(known) - min(known), 4)

@@ -110,9 +110,9 @@ class RouterTelemetry:
     router under ``router_metrics_rank{rank}.jsonl``, next to the
     per-replica engines' own ``serve_metrics`` files. Three row kinds:
 
-      * ``replica`` — a per-replica health/load sample (status, active,
-        queued, occupancy, progress watermark) at the router's sampling
-        cadence;
+      * ``replica`` — a per-replica health/load sample (status, role,
+        active, queued, parked KV handoffs, occupancy, progress
+        watermark) at the router's sampling cadence;
       * ``event``   — one lifecycle transition (failover, redispatch,
         shed, quarantine, rejoin, drain) with its router tick: the
         post-mortem trail of WHY streams moved between replicas;

@@ -290,19 +290,36 @@ def _router_section(run_dir: str) -> list[str]:
                 f"rejoins {summary.get('rejoins', 0)}  "
                 f"respawns {summary.get('respawns', 0)}"
                 + (f"  recovery {rec} ticks" if rec is not None else ""))
+            if (summary.get("handoffs") or summary.get("prefix_ships")
+                    or summary.get("cross_replica_hit_rate")):
+                # the disaggregation line (ISSUE 12): KV handoff +
+                # fleet-prefix traffic — absent on a colocated fleet
+                xr = summary.get("cross_replica_hit_rate")
+                lines.append(
+                    f"  handoffs {summary.get('handoffs', 0)}  "
+                    f"handoff_failures "
+                    f"{summary.get('handoff_failures', 0)}  "
+                    f"prefix_ships {summary.get('prefix_ships', 0)}  "
+                    f"kv_stream "
+                    f"{summary.get('kv_stream_bytes', 0) / 1e6:.2f} MB"
+                    + (f"  cross_replica_hit_rate {xr:.1%}"
+                       if xr is not None else ""))
         n_replicas = (summary.get("replicas") if summary
                       else 1 + max((s.get("replica", 0)
                                     for s in samples), default=0))
         occ = (summary or {}).get("replica_occupancy") or []
         served = {int(k): v for k, v in
                   ((summary or {}).get("served_by") or {}).items()}
-        lines.append(f"  {'replica':>7}  {'status':>11}  {'served':>6}  "
+        roles = (summary or {}).get("roles") or []
+        lines.append(f"  {'replica':>7}  {'role':>7}  {'status':>11}  "
+                     f"{'served':>6}  "
                      f"{'occupancy':>9}  {'failovers':>9}  "
                      f"{'quarantines':>11}  {'rejoins':>7}  "
-                     f"{'respawns':>8}")
+                     f"{'respawns':>8}  {'handoffs':>8}")
         for i in range(n_replicas or 0):
             status = next((s.get("status", "-") for s in reversed(samples)
                            if s.get("replica") == i), "-")
+            role = roles[i] if i < len(roles) else "both"
             lost = sum(1 for e in events
                        if e.get("event") == "replica_dead"
                        and e.get("replica") == i)
@@ -315,11 +332,16 @@ def _router_section(run_dir: str) -> list[str]:
             resp = sum(1 for e in events
                        if e.get("event") == "respawn"
                        and e.get("replica") == i)
+            # a handoff touches two replicas: count both directions
+            hoff = sum(1 for e in events
+                       if e.get("event") == "handoff"
+                       and i in (e.get("from_replica"),
+                                 e.get("to_replica")))
             o = occ[i] if i < len(occ) and occ[i] is not None else None
             lines.append(
-                f"  {i:>7}  {status:>11}  {served.get(i, 0):>6}  "
+                f"  {i:>7}  {role:>7}  {status:>11}  {served.get(i, 0):>6}  "
                 f"{(f'{o:.2%}' if o is not None else '-'):>9}  "
-                f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}")
+                f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}  {hoff:>8}")
     return lines
 
 
