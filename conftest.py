@@ -227,6 +227,12 @@ _QUICK = (
     "test_disagg.py::test_disagg_decode_death_after_import_is_lossless",
     "test_disagg.py::test_disagg_prefill_death_with_parked_streams_is_lossless",
     "test_disagg.py::test_fleet_prefix_steering_ships_blocks",
+    # KV compression over the stream (ISSUE 13): compressed-block
+    # handoff + rejection walls + int8 fleet shipping (the subprocess
+    # int8 wire run stays full-suite-only with its bf16 sibling)
+    "test_disagg.py::test_kv_roundtrip_int8_compressed_blocks",
+    "test_disagg.py::test_import_rejects_dtype_and_version_mismatch",
+    "test_disagg.py::test_fleet_prefix_ships_int8_blocks",
     "test_disagg.py::test_zero_recompiles_steady_state_disagg",
     "test_disagg.py::test_report_cli_renders_disagg_columns",
 )

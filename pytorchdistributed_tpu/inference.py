@@ -257,13 +257,16 @@ def reset_cache_positions(cache, new_index):
 def kv_cache_bytes(cache) -> int:
     """HBM bytes of a decode cache collection's K/V payload (dense rows
     or the paged block pool — the counter/table leaves are noise).
+    Includes the int8 pool's fp32 scale planes: they are real HBM the
+    compressed pool pays, so "same HBM budget" A/Bs charge for them.
     Shared by the serving engine's summary and bench.py's paged-capacity
     A/B, so both sides of every "same HBM budget" claim are measured by
     the one function."""
     total = 0
     for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
         name = getattr(path[-1], "key", str(path[-1]))
-        if name in ("cached_key", "cached_value"):
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
     return total
 

@@ -162,6 +162,36 @@ class TransformerConfig:
     # sizes the pool (block 0 is the engine's reserved trash block).
     kv_block_size: int = 0
     kv_blocks: int = 0
+    # KV compression (ISSUE 13). "bf16" stores pool blocks in cfg.dtype
+    # (the exact-bitwise default); "int8" stores int8 codes plus fp32
+    # per-(token, head) scale planes (`cached_key_scale` /
+    # `cached_value_scale`, [kv_blocks, kv_block_size, kv_heads]) in the
+    # same cache collection — absmax-over-head_dim quantization at block
+    # write time (ops/quant.kv_quantize), dequantized at read. Per-row
+    # scales mean the one-token-per-tick decode write never requantizes
+    # block neighbours. ~1.9x resident tokens at equal pool HBM
+    # (2 bytes/elem + 0 scale vs 1 byte/elem + 4/head_dim). Paged only.
+    kv_dtype: str = "bf16"              # bf16 | int8
+    # Sliding-window + attention-sink masking (StreamingLLM shape): when
+    # kv_window_tokens > 0, query at position p attends position j iff
+    # j < kv_sink_tokens or j > p - kv_window_tokens (the first sink
+    # tokens plus the trailing window, p itself included). Both are
+    # STATIC block multiples so the serving engine can retire
+    # fully-dead middle blocks back to the allocator mid-stream without
+    # retracing; masking lives in the compiled program, retirement is
+    # pure host bookkeeping. 0 = full attention (the default).
+    kv_sink_tokens: int = 0
+    kv_window_tokens: int = 0
+    # Decode-tick attention implementation for the paged pool: "gather"
+    # reassembles each slot's blocks into position order and runs the
+    # masked dense tail (bitwise-equal to the dense cache — the exact
+    # contract); "pallas" runs the scalar-prefetch paged flash kernel
+    # (ops/pallas_attention.paged_flash_attention) straight over the
+    # block pool on single-token ticks — no gather materialization, the
+    # serving default on TPU (tolerance-pinned vs gather, not bitwise:
+    # online softmax reassociates the reduction). Multi-token chunks
+    # (prefill, speculative verify) always take the gather path.
+    paged_attn: str = "gather"          # gather | pallas
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -231,6 +261,40 @@ class TransformerConfig:
                 raise ValueError(
                     f"kv_blocks {self.kv_blocks} must be >= 2 (block 0 is "
                     f"the reserved trash block)")
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"unknown kv_dtype {self.kv_dtype!r}; "
+                             f"one of ('bf16', 'int8')")
+        if self.kv_dtype == "int8" and not self.kv_block_size:
+            raise ValueError(
+                "kv_dtype='int8' requires the paged KV pool "
+                "(kv_block_size > 0): the scale planes are block-shaped")
+        if self.paged_attn not in ("gather", "pallas"):
+            raise ValueError(f"unknown paged_attn {self.paged_attn!r}; "
+                             f"one of ('gather', 'pallas')")
+        if self.paged_attn == "pallas" and not self.kv_block_size:
+            raise ValueError("paged_attn='pallas' requires the paged KV "
+                             "pool (kv_block_size > 0)")
+        if self.kv_sink_tokens < 0 or self.kv_window_tokens < 0:
+            raise ValueError("kv_sink_tokens / kv_window_tokens must be "
+                             ">= 0")
+        if self.kv_sink_tokens and not self.kv_window_tokens:
+            raise ValueError(
+                "kv_sink_tokens without kv_window_tokens is full attention "
+                "with extra steps — set kv_window_tokens > 0 to enable the "
+                "sliding window, or drop the sinks")
+        if self.kv_window_tokens:
+            if not self.kv_block_size:
+                raise ValueError(
+                    "sliding-window KV (kv_window_tokens > 0) requires the "
+                    "paged pool (kv_block_size > 0): retirement returns "
+                    "whole blocks to the allocator")
+            if (self.kv_window_tokens % self.kv_block_size
+                    or self.kv_sink_tokens % self.kv_block_size):
+                raise ValueError(
+                    f"kv_window_tokens {self.kv_window_tokens} and "
+                    f"kv_sink_tokens {self.kv_sink_tokens} must be "
+                    f"multiples of kv_block_size {self.kv_block_size} "
+                    f"(retirement is whole-block)")
         if self.decode_attend_len is not None and (
                 self.decode_attend_len < 1
                 or self.decode_attend_len > self.max_seq_len):
@@ -492,6 +556,8 @@ class SelfAttention(nn.Module):
                 # differs, which is what keeps paged outputs bitwise-equal
                 # to dense.
                 bs_blk = cfg.kv_block_size
+                pool_dtype = (jnp.int8 if cfg.kv_dtype == "int8"
+                              else cfg.dtype)
                 table_var = self.variable(
                     "cache", "block_table",
                     lambda: jnp.zeros((cfg.decode_slots, cfg.kv_pages),
@@ -499,11 +565,22 @@ class SelfAttention(nn.Module):
                 cached_k = self.variable(
                     "cache", "cached_key", jnp.zeros,
                     (cfg.kv_blocks, bs_blk, cfg.kv_heads, cfg.head_dim),
-                    cfg.dtype)
+                    pool_dtype)
                 cached_v = self.variable(
                     "cache", "cached_value", jnp.zeros,
                     (cfg.kv_blocks, bs_blk, cfg.kv_heads, cfg.head_dim),
-                    cfg.dtype)
+                    pool_dtype)
+                if cfg.kv_dtype == "int8":
+                    # fp32 dequant scale per written (token, head) row —
+                    # same cache collection, so the engine's block
+                    # gather/scatter, export/import and prefix shipping
+                    # carry the scales with the codes automatically
+                    k_scale_var = self.variable(
+                        "cache", "cached_key_scale", jnp.zeros,
+                        (cfg.kv_blocks, bs_blk, cfg.kv_heads), jnp.float32)
+                    v_scale_var = self.variable(
+                        "cache", "cached_value_scale", jnp.zeros,
+                        (cfg.kv_blocks, bs_blk, cfg.kv_heads), jnp.float32)
                 if not self.is_initializing():
                     # scatter each row's s tokens into its table's blocks;
                     # positions past the context (padded prefill tails)
@@ -514,21 +591,73 @@ class SelfAttention(nn.Module):
                     blk = jnp.take_along_axis(table_var.value, inb, axis=1)
                     blk = jnp.where(pos < cfg.max_seq_len, blk, 0)
                     off = pos % bs_blk
-                    cached_k.value = cached_k.value.at[blk, off].set(
-                        k.astype(cfg.dtype))
-                    cached_v.value = cached_v.value.at[blk, off].set(
-                        v.astype(cfg.dtype))
+                    if cfg.kv_dtype == "int8":
+                        from pytorchdistributed_tpu.ops.quant import (
+                            kv_quantize,
+                        )
+
+                        qk, sk = kv_quantize(k)
+                        qv, sv = kv_quantize(v)
+                        cached_k.value = cached_k.value.at[blk, off].set(qk)
+                        cached_v.value = cached_v.value.at[blk, off].set(qv)
+                        k_scale_var.value = (
+                            k_scale_var.value.at[blk, off].set(sk))
+                        v_scale_var.value = (
+                            v_scale_var.value.at[blk, off].set(sv))
+                    else:
+                        cached_k.value = cached_k.value.at[blk, off].set(
+                            k.astype(cfg.dtype))
+                        cached_v.value = cached_v.value.at[blk, off].set(
+                            v.astype(cfg.dtype))
                     idx_var.value = idx + s
-                # gather the attended blocks back into position order:
-                # with max_seq_len % bs == 0 the gathered window is
-                # exactly the dense attend window, so every reduction
-                # below keeps its shape — the bitwise-parity property the
-                # serving tests pin
                 attend = cfg.decode_attend_len or cfg.max_seq_len
                 na = -(-attend // bs_blk)
                 attend = na * bs_blk
-                kc = paged_gather(cached_k.value, table_var.value[:, :na])
-                vc = paged_gather(cached_v.value, table_var.value[:, :na])
+                if cfg.paged_attn == "pallas" and s == 1:
+                    # decode tick on the Pallas paged kernel: q attends
+                    # the pool STRAIGHT through the block table — the
+                    # gathered [slots, attend, ...] copy below never
+                    # materializes. Tolerance-pinned vs the gather path
+                    # (online softmax reassociates); chunks (s > 1:
+                    # prefill, spec verify) stay on the gather tail.
+                    from pytorchdistributed_tpu.ops.pallas_attention import (
+                        paged_flash_attention,
+                    )
+
+                    out = paged_flash_attention(
+                        q[:, 0], cached_k.value, cached_v.value,
+                        table_var.value[:, :na], idx,
+                        k_scale=(k_scale_var.value
+                                 if cfg.kv_dtype == "int8" else None),
+                        v_scale=(v_scale_var.value
+                                 if cfg.kv_dtype == "int8" else None),
+                        sink_tokens=cfg.kv_sink_tokens,
+                        window_tokens=cfg.kv_window_tokens,
+                    )[:, None].astype(cfg.dtype)
+                    kc = vc = None
+                else:
+                    # gather the attended blocks back into position
+                    # order: with max_seq_len % bs == 0 the gathered
+                    # window is exactly the dense attend window, so every
+                    # reduction below keeps its shape — the bitwise-
+                    # parity property the serving tests pin
+                    kc = paged_gather(cached_k.value,
+                                      table_var.value[:, :na])
+                    vc = paged_gather(cached_v.value,
+                                      table_var.value[:, :na])
+                    if cfg.kv_dtype == "int8":
+                        from pytorchdistributed_tpu.ops.quant import (
+                            kv_dequantize,
+                        )
+
+                        kc = kv_dequantize(
+                            kc, paged_gather(k_scale_var.value,
+                                             table_var.value[:, :na]),
+                            cfg.dtype)
+                        vc = kv_dequantize(
+                            vc, paged_gather(v_scale_var.value,
+                                             table_var.value[:, :na]),
+                            cfg.dtype)
             else:
                 cached_k = self.variable(
                     "cache", "cached_key", jnp.zeros,
@@ -564,25 +693,38 @@ class SelfAttention(nn.Module):
                 attend = cfg.decode_attend_len or cfg.max_seq_len
                 kc = cached_k.value[:, :attend]
                 vc = cached_v.value[:, :attend]
-            if rep > 1:
-                kc = jnp.repeat(kc, rep, axis=2)
-                vc = jnp.repeat(vc, rep, axis=2)
-            # Masked dense attention over the live window: the current
-            # chunk's token i (absolute position idx+i) sees cache slots
-            # j <= idx+i. fp32 softmax like the training backends.
-            # (slot decode: idx is [b], so pos/valid grow a leading row
-            # dim — each slot masks against its own position)
-            pos = (idx[:, None] if cfg.decode_slots else idx) + jnp.arange(s)
-            valid = jnp.arange(attend) <= pos[..., None]
-            scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
-                                preferred_element_type=jnp.float32)
-            scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-            scores = jnp.where(valid[:, None] if cfg.decode_slots
-                               else valid[None, None], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1)
-            out = jnp.einsum("bhij,bjhd->bihd", probs.astype(cfg.dtype), vc,
-                             preferred_element_type=jnp.float32
-                             ).astype(cfg.dtype)
+            if kc is not None:
+                if rep > 1:
+                    kc = jnp.repeat(kc, rep, axis=2)
+                    vc = jnp.repeat(vc, rep, axis=2)
+                # Masked dense attention over the live window: the
+                # current chunk's token i (absolute position idx+i) sees
+                # cache slots j <= idx+i. fp32 softmax like the training
+                # backends. (slot decode: idx is [b], so pos/valid grow a
+                # leading row dim — each slot masks against its own
+                # position)
+                pos = (idx[:, None] if cfg.decode_slots
+                       else idx) + jnp.arange(s)
+                valid = jnp.arange(attend) <= pos[..., None]
+                if cfg.kv_window_tokens:
+                    # sink + sliding window (StreamingLLM shape): keep
+                    # the first sink tokens plus the trailing window —
+                    # the positions outside are exactly the rows the
+                    # engine retires to the allocator, so the gathered
+                    # garbage there is masked before the softmax
+                    j = jnp.arange(attend)
+                    valid &= ((j < cfg.kv_sink_tokens)
+                              | (j > pos[..., None] - cfg.kv_window_tokens))
+                scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+                scores = jnp.where(valid[:, None] if cfg.decode_slots
+                                   else valid[None, None], scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bhij,bjhd->bihd",
+                                 probs.astype(cfg.dtype), vc,
+                                 preferred_element_type=jnp.float32
+                                 ).astype(cfg.dtype)
         else:
             if rep > 1 and cfg.attention != "pallas":
                 # Broadcast KV groups to full head count for backends that

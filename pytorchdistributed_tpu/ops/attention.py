@@ -45,6 +45,8 @@ def paged_gather(pool, block_tables):
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                    k_scale=None, v_scale=None, sink_tokens: int = 0,
+                    window_tokens: int = 0,
                     scale: float | None = None) -> jax.Array:
     """Reference paged decode attention — the math twin of the serving
     tick's in-model path (models/transformer.py paged branch), exposed so
@@ -53,13 +55,22 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, *,
     Args:
       q: ``[slots, q_len, heads, head_dim]`` current-chunk queries (q_len
         is 1 for a decode tick, >1 for a chunked-prefill step).
-      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``.
+      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``,
+        model dtype or int8 (the compressed pool — pass the scales).
       block_tables: ``[slots, blocks_per_slot]`` int32.
       lengths: ``[slots]`` int32 — tokens already cached per slot; query
         token i of a slot sits at absolute position lengths + i and
         attends cache positions <= it. The CURRENT chunk's K/V must
         already be written into the pool (the model writes before it
         attends), exactly like the dense decode contract.
+      k_scale / v_scale: ``[num_blocks, block_size, kv_heads]`` fp32
+        per-(token, head) dequant scales for an int8 pool (the canonical
+        ops/quant.kv_dequantize math, cast to q's dtype — bitwise-equal
+        to the in-model int8 gather read).
+      sink_tokens / window_tokens: sink+sliding-window mask
+        (window_tokens 0 = full attention): position j is attendable by
+        the query at position p iff ``j < sink_tokens or
+        j > p - window_tokens`` (and j <= p).
 
     Returns ``[slots, q_len, heads, head_dim]`` in q's dtype. Bitwise
     equal (fp32 accumulate, fp32 softmax) to the dense cache path over
@@ -70,12 +81,22 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, *,
     head_dim = q.shape[-1]
     kc = paged_gather(k_pool, block_tables)
     vc = paged_gather(v_pool, block_tables)
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if k_scale is not None:
+        from pytorchdistributed_tpu.ops.quant import kv_dequantize
+
+        kc = kv_dequantize(kc, paged_gather(k_scale, block_tables), q.dtype)
+        vc = kv_dequantize(vc, paged_gather(v_scale, block_tables), q.dtype)
     rep = q.shape[2] // kc.shape[2]
     if rep > 1:
         kc = jnp.repeat(kc, rep, axis=2)
         vc = jnp.repeat(vc, rep, axis=2)
     pos = lengths[:, None] + jnp.arange(q.shape[1])          # [slots, q]
     valid = jnp.arange(kc.shape[1]) <= pos[..., None]        # [slots, q, j]
+    if window_tokens:
+        j = jnp.arange(kc.shape[1])
+        valid &= (j < sink_tokens) | (j > pos[..., None] - window_tokens)
     scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
                         preferred_element_type=jnp.float32)
     if scale is None:

@@ -479,11 +479,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
 # is DMA'd as grid step j), the canonical PagedAttention dataflow.
 
 
-def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_s, m_s, l_s, *, block_size: int, num_blocks: int,
-                  kv_heads: int, scale: float):
+def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, *rest,
+                  block_size: int, num_blocks: int, kv_heads: int,
+                  scale: float, quantized: bool, sink: int, window: int):
     """Online-softmax over one slot's table blocks; grid
-    (slots·kv_heads, blocks_per_slot), rows = the kv head's q group."""
+    (slots·kv_heads, blocks_per_slot), rows = the kv head's q group.
+    ``quantized`` adds two scale refs (int8 pool, fp32 per-row scales,
+    dequantized in VMEM right before the dots); ``window`` > 0 applies
+    the sink+sliding-window mask and skips fully-dead middle blocks —
+    the blocks the serving engine retires to the allocator."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_s, m_s, l_s = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, acc_s, m_s, l_s = rest
     b, ji = pl.program_id(0), pl.program_id(1)
 
     @pl.when(ji == 0)
@@ -498,17 +507,32 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
     # dead slots (length 0) still run block 0 — masked rows are exact
     # zeros, the same garbage-tolerance contract as the reference path
     run = ji * block_size <= length
+    if window:
+        # sliding window: a middle block whose last position already fell
+        # out of every live query's window (and past the sinks) is fully
+        # masked — and its table entry points at trash once the engine
+        # retires it — so skip its DMA outright
+        dead = ((ji * block_size >= sink)
+                & ((ji + 1) * block_size <= length - window + 1))
+        run = run & ~dead
 
     @pl.when(run)
     def _compute():
         q = q_ref[0]                                       # [group, d]
         k = k_ref[0, :, 0]                                 # [bs, d]
+        if quantized:
+            # canonical dequant (ops/quant.kv_dequantize spelling):
+            # int8 → fp32 × per-row scale → compute dtype
+            k = (k.astype(jnp.float32)
+                 * ks_ref[0, :, 0][:, None]).astype(q.dtype)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [group, bs]
         pos = ji * block_size + lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
         valid = pos <= length
+        if window:
+            valid &= (pos < sink) | (pos > length - window)
         logits = jnp.where(valid, logits, _NEG_INF)
         m_prev = m_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(logits, -1, keepdims=True))
@@ -517,6 +541,9 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
         l_s[...] = l_s[...] * corr + jnp.sum(p, -1, keepdims=True)
         m_s[...] = m_new
         v = v_ref[0, :, 0]                                 # [bs, d]
+        if quantized:
+            v = (v.astype(jnp.float32)
+                 * vs_ref[0, :, 0][:, None]).astype(q_ref.dtype)
         acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -528,33 +555,62 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_flash_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                          k_scale=None, v_scale=None,
+                          sink_tokens: int = 0, window_tokens: int = 0,
                           scale: float | None = None,
                           interpret: bool | None = None):
-    """One decode tick of paged attention, pool-native.
+    """One decode tick of paged attention, pool-native — the serving
+    engine's default decode hot path (ISSUE 13; gather fallback via
+    ``ServingEngine(paged_attn=...)`` / ``PTD_PAGED_ATTN``).
 
     Args:
       q: ``[slots, heads, head_dim]`` — each slot's single current-token
         query (its K/V already written into the pool, the decode
         contract).
-      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``.
+      k_pool / v_pool: ``[num_blocks, block_size, kv_heads, head_dim]``,
+        the model dtype or int8 (compressed pool).
       block_tables: ``[slots, blocks_per_slot]`` int32 physical block ids
-        (entries past a slot's live length point at the trash block 0).
+        (entries past a slot's live length — and retired window blocks —
+        point at the trash block 0).
       lengths: ``[slots]`` int32 — the query attends positions <= length.
+      k_scale / v_scale: ``[num_blocks, block_size, kv_heads]`` fp32
+        per-(token, head) dequant scales; required iff the pool is int8.
+      sink_tokens / window_tokens: static sink+sliding-window mask
+        (window_tokens 0 = full attention): position j is attendable iff
+        ``j < sink_tokens or j > length - window_tokens``; fully-dead
+        middle blocks are skipped (no DMA) — they are the blocks the
+        engine retires back to the allocator mid-stream.
 
     Returns ``[slots, heads, head_dim]``. Matches
     ops.attention.paged_attention to fp32 online-softmax tolerance (the
-    reassociated flash recurrence is not bitwise — the serving tick's
-    pinned-parity path stays on the reference gather; this kernel is the
-    HBM-traffic-optimal twin for pool sizes where the gathered copy
-    dominates). Grouped-query native: each (slot, kv_head) program
-    streams its group's shared KV block once. On TPU the group width
-    (heads/kv_heads) rides the sublane dim — pad q to a multiple of 8
-    rows for compiled-mode tiling; interpret mode (the CPU sim) has no
-    such constraint."""
+    reassociated flash recurrence is not bitwise — the bitwise-parity
+    contract vs generate() holds on the reference gather path; this
+    kernel never materializes the [slots, blocks*block_size, ...]
+    gathered copy, the HBM-traffic-optimal hot path). Grouped-query
+    native: each (slot, kv_head) program streams its group's shared KV
+    block once. On TPU the group width (heads/kv_heads) rides the
+    sublane dim — pad q to a multiple of 8 rows for compiled-mode
+    tiling; interpret mode (the CPU sim) has no such constraint."""
     slots, h, d = q.shape
     nb, bs, hk, _ = k_pool.shape
     if h % hk:
         raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
+    quantized = k_pool.dtype == jnp.int8
+    if quantized != (k_scale is not None and v_scale is not None):
+        raise ValueError(
+            "k_scale/v_scale must be provided iff the pool is int8 "
+            f"(pool {k_pool.dtype}, k_scale "
+            f"{'set' if k_scale is not None else 'None'})")
+    if quantized and (k_scale.shape != (nb, bs, hk)
+                      or v_scale.shape != (nb, bs, hk)):
+        raise ValueError(
+            f"scale planes must be [num_blocks, block_size, kv_heads] = "
+            f"{(nb, bs, hk)}; got {k_scale.shape} / {v_scale.shape}")
+    if window_tokens < 0 or sink_tokens < 0 or (
+            window_tokens and (window_tokens % bs or sink_tokens % bs)):
+        raise ValueError(
+            f"sink_tokens {sink_tokens} / window_tokens {window_tokens} "
+            f"must be non-negative multiples of block_size {bs}")
     group = h // hk
     mb = block_tables.shape[1]
     scale = (d**-0.5) if scale is None else scale
@@ -563,18 +619,25 @@ def paged_flash_attention(q, k_pool, v_pool, block_tables, lengths, *,
     from jax.experimental.pallas import tpu as pltpu
 
     qf = q.reshape(slots * hk, group, d)  # kv head g owns q rows g·group+
+    kv_spec = pl.BlockSpec((1, bs, 1, d),
+                           lambda b, j, tbl, ln: (tbl[b // hk, j], 0,
+                                                  b % hk, 0))
+    in_specs = [
+        pl.BlockSpec((1, group, d), lambda b, j, tbl, ln: (b, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qf, k_pool, v_pool]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, bs, 1), lambda b, j, tbl, ln: (tbl[b // hk, j], 0, b % hk))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots * hk, mb),
-        in_specs=[
-            pl.BlockSpec((1, group, d), lambda b, j, tbl, ln: (b, 0, 0)),
-            pl.BlockSpec((1, bs, 1, d),
-                         lambda b, j, tbl, ln: (tbl[b // hk, j], 0,
-                                                b % hk, 0)),
-            pl.BlockSpec((1, bs, 1, d),
-                         lambda b, j, tbl, ln: (tbl[b // hk, j], 0,
-                                                b % hk, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, group, d),
                                lambda b, j, tbl, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -585,12 +648,14 @@ def paged_flash_attention(q, k_pool, v_pool, block_tables, lengths, *,
     )
     kernel = functools.partial(
         _paged_kernel, block_size=bs, num_blocks=mb, kv_heads=hk,
-        scale=scale)
+        scale=scale, quantized=quantized, sink=int(sink_tokens),
+        window=int(window_tokens))
+    out_dtype = q.dtype
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((slots * hk, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((slots * hk, group, d), out_dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      qf, k_pool, v_pool)
+      *operands)
     return out.reshape(slots, h, d)

@@ -263,6 +263,38 @@ def quantized_dot_general(mode: str):
 
 
 # ---------------------------------------------------------------------------
+# KV-cache block quantization (serving, ISSUE 13)
+# ---------------------------------------------------------------------------
+#
+# The paged KV pool stores int8 codes plus an fp32 scale per written
+# (token, head) row — absmax over head_dim / 127, the same symmetric
+# recipe as the matmul path above. Per-row granularity (rather than
+# per-block) means an incremental decode write never has to requantize
+# neighbours already resident in the block, which is what makes int8
+# compose with the engine's one-token-per-tick `.at[blk, off].set`
+# write path without read-modify-write of whole blocks.
+
+
+def kv_quantize(x):
+    """Quantize a KV tensor ``[..., head_dim]`` for pool storage.
+
+    Returns ``(codes int8 [...same shape], scale fp32 [...minus last
+    dim])`` with scale = absmax over head_dim / 127 per leading row.
+    Zero rows get scale 1 (codes are all-zero anyway)."""
+    scale = absmax_scale(x, (x.ndim - 1,))
+    return quantize(x, scale), jnp.squeeze(scale, axis=x.ndim - 1)
+
+
+def kv_dequantize(codes, scale, dtype):
+    """Invert :func:`kv_quantize`: ``codes int8 [..., head_dim]`` ×
+    ``scale fp32 [...]`` → ``dtype``. This exact spelling (int8→fp32,
+    multiply, cast) is the canonical dequant all readers — the in-model
+    gather path, the reference oracle and the Pallas kernel — must
+    match, so the int8 tolerance-twin suites pin one math."""
+    return (codes.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # optional quantization stats (telemetry/diagnostics.py — ISSUE 6)
 # ---------------------------------------------------------------------------
 

@@ -67,6 +67,7 @@ import contextlib
 import dataclasses
 import functools
 import itertools
+import os
 import time
 
 import jax
@@ -117,6 +118,33 @@ def slot_models(model, num_slots: int):
 
 def _leaf_name(path) -> str:
     return getattr(path[-1], "key", str(path[-1]))
+
+
+# The paged pool's cache-collection leaves, with the offset of the block
+# axis from the END of each leaf's shape (scanned layer stacks prepend
+# dims, so the end is the stable anchor): K/V pools are
+# [..., kv_blocks, block_size, kv_heads, head_dim] (block axis ndim-4),
+# the int8 scale planes drop head_dim (ndim-3). Everything that moves
+# blocks — the compiled gather/scatter pair, the prefill-chunk merge,
+# the export/import payloads and the fleet prefix stream — keys off this
+# one table, which is how the int8 pool's scales ride every existing
+# block-transport path without a second code path.
+POOL_LEAF_AXIS = {
+    "cached_key": 4, "cached_value": 4,
+    "cached_key_scale": 3, "cached_value_scale": 3,
+}
+
+
+def _pool_block_axis(name: str, ndim: int) -> int:
+    """Block-axis index for a pool leaf, by its (path or bare) name."""
+    return ndim - POOL_LEAF_AXIS[name.rsplit("/", 1)[-1]]
+
+
+#: KV wire-payload schema version (ISSUE 13): bumped when the payload's
+#: pool-leaf set or meaning changes (v2 added kv_dtype + the int8 scale
+#: planes). import_kv_blocks rejects any other version loudly — a bf16
+#: replica must never scatter an int8 payload's codes into its pool.
+KV_WIRE_VERSION = 2
 
 
 @functools.partial(
@@ -193,16 +221,25 @@ def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
 
 
 def paged_slot_models(model, num_slots: int, block_size: int,
-                      num_blocks: int):
+                      num_blocks: int, *, kv_dtype: str = "bf16",
+                      kv_sink_tokens: int = 0, kv_window_tokens: int = 0,
+                      paged_attn: str = "gather"):
     """(tick_model, chunk_model) for the PAGED engine: both share the one
     block pool (pool shapes carry no slot dim); the tick model decodes
     all ``num_slots`` rows, the chunk model runs one request's prefill
     chunk at batch 1 (``decode_slots=1``) against the same pool. Same
-    dense-path pinning rationale as slot_models."""
+    dense-path pinning rationale as slot_models. The KV-compression
+    knobs (ISSUE 13) ride here: ``kv_dtype`` picks the pool's storage
+    dtype (int8 adds the scale-plane cache leaves), sink/window set the
+    STATIC attention-window mask, and ``paged_attn`` picks the decode
+    tick's attention implementation (the chunked-prefill path always
+    gathers — chunks run s > 1, the Pallas kernel is decode-only)."""
     cfg = dataclasses.replace(
         model.cfg, decode=True, attention="dense", decode_attend_len=None,
         decode_slots=num_slots, kv_block_size=block_size,
-        kv_blocks=num_blocks)
+        kv_blocks=num_blocks, kv_dtype=kv_dtype,
+        kv_sink_tokens=kv_sink_tokens, kv_window_tokens=kv_window_tokens,
+        paged_attn=paged_attn)
     return (model.clone(cfg=cfg),
             model.clone(cfg=dataclasses.replace(cfg, decode_slots=1)))
 
@@ -291,10 +328,10 @@ def paged_prefill_chunk(model, weights, cache, chunk, start, table_row,
                               mutable=["cache"])
 
     def merge(path, big, new):
-        # only the pools mutated; the big cache's counter/table leaves
-        # are scratch the engine re-stamps anyway
-        return (new if _leaf_name(path) in ("cached_key", "cached_value")
-                else big)
+        # only the pools mutated (K/V codes AND, on an int8 pool, their
+        # scale planes); the big cache's counter/table leaves are
+        # scratch the engine re-stamps anyway
+        return new if _leaf_name(path) in POOL_LEAF_AXIS else big
 
     new_cache = jax.tree_util.tree_map_with_path(merge, cache, mut["cache"])
     off = jnp.clip(true_len - 1 - start, 0, chunk.shape[1] - 1)
@@ -404,9 +441,10 @@ def kv_block_gather(cache, block_ids):
     sync. Returns the pool leaves (cached_key/cached_value per layer
     stack) in tree-flatten order."""
     TRACE_COUNTS["kv_block_gather"] += 1
-    return [jnp.take(leaf, block_ids, axis=leaf.ndim - 4)
+    return [jnp.take(leaf, block_ids,
+                     axis=_pool_block_axis(_leaf_name(path), leaf.ndim))
             for path, leaf in jax.tree_util.tree_leaves_with_path(cache)
-            if _leaf_name(path) in ("cached_key", "cached_value")]
+            if _leaf_name(path) in POOL_LEAF_AXIS]
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))
@@ -421,10 +459,10 @@ def kv_block_scatter(cache, block_ids, payload):
     it = iter(payload)
 
     def put(path, leaf):
-        if _leaf_name(path) not in ("cached_key", "cached_value"):
+        if _leaf_name(path) not in POOL_LEAF_AXIS:
             return leaf
         new = next(it)
-        axis = leaf.ndim - 4
+        axis = _pool_block_axis(_leaf_name(path), leaf.ndim)
         moved = jnp.moveaxis(leaf, axis, 0)
         out = moved.at[block_ids].set(
             jnp.moveaxis(new.astype(leaf.dtype), axis, 0))
@@ -467,6 +505,12 @@ class KVBlockPayload:
     sampling: SamplingParams
     stop_ids: tuple
     leaves: list
+    # pool storage dtype the leaves were gathered from ("bf16"|"int8" —
+    # int8 payloads also carry the scale-plane leaves) and the payload
+    # schema version; both are checked at import so a mismatched fleet
+    # fails with a sentence, not garbage tokens
+    kv_dtype: str = "bf16"
+    wire_version: int = KV_WIRE_VERSION
 
     @property
     def num_blocks(self) -> int:
@@ -489,6 +533,8 @@ class PrefixBlockPayload:
     tokens: np.ndarray
     block_size: int
     leaves: list
+    kv_dtype: str = "bf16"
+    wire_version: int = KV_WIRE_VERSION
 
     @property
     def num_blocks(self) -> int:
@@ -536,7 +582,8 @@ def kv_payload_to_wire(p: KVBlockPayload) -> dict:
                 max_new_tokens=p.max_new_tokens,
                 sampling=dataclasses.asdict(p.sampling),
                 stop_ids=list(p.stop_ids),
-                leaves=_leaves_to_wire(p.leaves))
+                leaves=_leaves_to_wire(p.leaves),
+                kv_dtype=p.kv_dtype, wire_version=p.wire_version)
 
 
 def kv_payload_from_wire(d: dict) -> KVBlockPayload:
@@ -547,20 +594,27 @@ def kv_payload_from_wire(d: dict) -> KVBlockPayload:
         max_new_tokens=int(d["max_new_tokens"]),
         sampling=SamplingParams(**d["sampling"]),
         stop_ids=tuple(d["stop_ids"]),
-        leaves=_leaves_from_wire(d["leaves"]))
+        leaves=_leaves_from_wire(d["leaves"]),
+        # pre-v2 senders carried neither field: report them as v1 so the
+        # importer's version check names the mismatch instead of KeyError
+        kv_dtype=str(d.get("kv_dtype", "bf16")),
+        wire_version=int(d.get("wire_version", 1)))
 
 
 def prefix_payload_to_wire(p: PrefixBlockPayload) -> dict:
     return dict(tokens=[int(t) for t in p.tokens],
                 block_size=p.block_size,
-                leaves=_leaves_to_wire(p.leaves))
+                leaves=_leaves_to_wire(p.leaves),
+                kv_dtype=p.kv_dtype, wire_version=p.wire_version)
 
 
 def prefix_payload_from_wire(d: dict) -> PrefixBlockPayload:
     return PrefixBlockPayload(
         tokens=np.asarray(d["tokens"], np.int32),
         block_size=int(d["block_size"]),
-        leaves=_leaves_from_wire(d["leaves"]))
+        leaves=_leaves_from_wire(d["leaves"]),
+        kv_dtype=str(d.get("kv_dtype", "bf16")),
+        wire_version=int(d.get("wire_version", 1)))
 
 
 class Request:
@@ -714,6 +768,32 @@ class ServingEngine:
         compiles; warmup() collapses to one probe round per bucket.
         The contract is never-fails: any cache defect quarantines the
         entry and the engine falls back to the plain jit path.
+      kv_dtype: paged pool storage dtype (ISSUE 13): "bf16" (default —
+        the model dtype; the bitwise-vs-generate() contract holds) or
+        "int8" — blocks store int8 codes plus per-(token, head) fp32
+        scale planes (extra cache leaves), quantized at block-write
+        time and dequantized inside the attention read
+        (ops/quant.kv_quantize / kv_dequantize). ~1.9x more resident
+        tokens per HBM byte at equal pool bytes; outputs are
+        tolerance-accurate, not bitwise. None inherits the model cfg.
+      kv_sink_tokens / kv_window_tokens: sink + sliding-window
+        attention over the paged cache (StreamingLLM-style): a query at
+        position p attends cache position j iff ``j < kv_sink_tokens or
+        j > p - kv_window_tokens``. Both are STATIC block-multiples
+        (no retrace as streams grow). Middle blocks that fall fully
+        dead are RETIRED mid-stream — decref'd back to the allocator
+        while the stream lives — so a long stream holds sink + window
+        blocks, not its whole history, and the freed capacity
+        immediately backs new admissions. 0/0 = full attention
+        (default). None inherits the model cfg.
+      paged_attn: the decode tick's attention implementation:
+        "gather" (XLA gather + masked dense — the bitwise reference),
+        "pallas" (the fused paged flash kernel,
+        ops/pallas_attention.paged_flash_attention — no [slots,
+        attend_len] gather materialization), or None (default) →
+        the PTD_PAGED_ATTN env var, else "auto" = pallas on TPU
+        backends, gather elsewhere. Prefill chunks and the spec tick's
+        draft rollout always use the gather read.
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
@@ -725,7 +805,10 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunks_per_step: int = 1,
                  spec_k: int = 0, draft_config=None, draft_params=None,
-                 compile_cache="auto"):
+                 compile_cache="auto", kv_dtype: str | None = None,
+                 kv_sink_tokens: int | None = None,
+                 kv_window_tokens: int | None = None,
+                 paged_attn: str | None = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -736,6 +819,37 @@ class ServingEngine:
             block_size = model.cfg.kv_block_size
             num_blocks = num_blocks or model.cfg.kv_blocks
         self.paged = block_size > 0
+        # KV-compression knobs (ISSUE 13): None inherits the model cfg,
+        # so a model already configured int8/windowed just works
+        kv_dtype = model.cfg.kv_dtype if kv_dtype is None else kv_dtype
+        kv_sink_tokens = (model.cfg.kv_sink_tokens
+                          if kv_sink_tokens is None else kv_sink_tokens)
+        kv_window_tokens = (model.cfg.kv_window_tokens
+                            if kv_window_tokens is None
+                            else kv_window_tokens)
+        if paged_attn is None:
+            paged_attn = (model.cfg.paged_attn
+                          if model.cfg.paged_attn != "gather"
+                          else os.environ.get("PTD_PAGED_ATTN", "auto"))
+        if paged_attn not in ("auto", "gather", "pallas"):
+            raise ValueError(
+                f"paged_attn must be 'auto', 'gather' or 'pallas', got "
+                f"{paged_attn!r}")
+        if paged_attn == "auto":
+            # backend-aware default: the fused kernel is the hot path on
+            # real accelerators; CPU (tests, dev) keeps the gather read,
+            # whose decode tick is bitwise generate()'s
+            paged_attn = ("pallas" if jax.default_backend() == "tpu"
+                          else "gather")
+        if not self.paged and (kv_dtype != "bf16" or kv_sink_tokens
+                               or kv_window_tokens):
+            raise ValueError(
+                "kv_dtype / kv_sink_tokens / kv_window_tokens are "
+                "paged-engine knobs (ISSUE 13) — pass block_size > 0")
+        self.kv_dtype = kv_dtype
+        self.kv_sink_tokens = int(kv_sink_tokens)
+        self.kv_window_tokens = int(kv_window_tokens)
+        self.paged_attn = paged_attn if self.paged else "gather"
         if self.paged:
             max_len = model.cfg.max_seq_len
             if max_len % block_size:
@@ -755,7 +869,10 @@ class ServingEngine:
             self.block_size = block_size
             self.num_blocks = num_blocks
             self._tick_model, self._chunk_model = paged_slot_models(
-                model, num_slots, block_size, num_blocks)
+                model, num_slots, block_size, num_blocks,
+                kv_dtype=kv_dtype, kv_sink_tokens=self.kv_sink_tokens,
+                kv_window_tokens=self.kv_window_tokens,
+                paged_attn=self.paged_attn)
             self._prefill_model = None
         else:
             self.block_size = 0
@@ -812,9 +929,15 @@ class ServingEngine:
             # into its own shallower pool), so its geometry must match
             draft_base = model.clone(cfg=dataclasses.replace(
                 draft_config, max_seq_len=model.cfg.max_seq_len))
+            # the draft pool rides the same compression + window (it
+            # shares block IDS with the target, so a retired block must
+            # be dead for both) but keeps the gather read: its rollout
+            # runs inside a scanned spec tick, not the plain decode tick
             self._draft_tick_model, self._draft_chunk_model = \
                 paged_slot_models(draft_base, num_slots, self.block_size,
-                                  self.num_blocks)
+                                  self.num_blocks, kv_dtype=kv_dtype,
+                                  kv_sink_tokens=self.kv_sink_tokens,
+                                  kv_window_tokens=self.kv_window_tokens)
             self._draft_weights = (draft_params["params"]
                                    if "params" in draft_params
                                    else draft_params)
@@ -1000,6 +1123,7 @@ class ServingEngine:
             if self.paged:
                 used = self._alloc.usable - self._alloc.free_count
                 st["block_used_sum"] += used / self._alloc.usable
+                st["peak_blocks_used"] = max(st["peak_blocks_used"], used)
                 row = dict(blocks_used=used,
                            blocks_free=self._alloc.free_count)
                 for slot in self._active:
@@ -1052,6 +1176,7 @@ class ServingEngine:
         st["occupancy_sum"] += n_active / self.num_slots
         used = self._alloc.usable - self._alloc.free_count
         st["block_used_sum"] += used / self._alloc.usable
+        st["peak_blocks_used"] = max(st["peak_blocks_used"], used)
         decoded = accepted = 0
         for slot, req in list(self._active.items()):
             n = int(ns[slot])
@@ -1312,12 +1437,35 @@ class ServingEngine:
         prefix-cache eviction, preempt the YOUNGEST resident request
         (free its blocks, requeue it at the front — it resumes later by
         re-prefilling prompt + generated, output unchanged) until the
-        older stream can proceed."""
+        older stream can proceed.
+
+        With a sliding window configured (kv_window_tokens > 0) this is
+        also where blocks RETIRE: before growing a slot, any middle
+        block whose every position has fallen out of the sink+window
+        visible set — for this tick's MINIMUM query position, so spec
+        rounds are covered too — is decref'd back to the allocator, its
+        table entry pointed at the trash block, and its list entry
+        zeroed as a sentinel. Dead is forever (positions only grow), so
+        each block retires exactly once, and the freed capacity backs
+        the very growth loop below — a long stream's footprint is
+        sink + window + a block, not its whole history."""
+        bs = self.block_size
+        win, sink = self.kv_window_tokens, self.kv_sink_tokens
         for slot in sorted(self._active,
                            key=lambda s: self._admit_order[s]):
             if slot not in self._active:
                 continue  # preempted by an older slot's growth
             blocks = self._slot_blocks[slot]
+            if win:
+                qlo = int(self._lengths[slot])  # this tick's first query
+                for bi in range(sink // bs, len(blocks)):
+                    if (bi + 1) * bs > qlo - win + 1:
+                        break  # first live block; younger ones follow
+                    if blocks[bi]:
+                        self._alloc.decref(blocks[bi])
+                        blocks[bi] = 0
+                        self._tables[slot, bi] = 0
+                        self._stats["retired_blocks"] += 1
             bi = min(int(self._lengths[slot]) + self.spec_k,
                      self.cfg.max_seq_len - 1) // self.block_size
             while bi >= len(blocks):
@@ -1343,9 +1491,12 @@ class ServingEngine:
     def _release_slot(self, slot: int) -> None:
         """Return a slot's blocks to the pool (radix-cached blocks
         survive via the cache's own reference) and point its table at
-        the trash block so its garbage ticks stay harmless."""
+        the trash block so its garbage ticks stay harmless. Zero
+        entries are window-retirement sentinels — those refs were
+        already returned mid-stream."""
         for b in self._slot_blocks[slot]:
-            self._alloc.decref(b)
+            if b:
+                self._alloc.decref(b)
         self._slot_blocks[slot] = []
         self._tables[slot, :] = 0
         self._lengths[slot] = 0
@@ -1364,12 +1515,13 @@ class ServingEngine:
         return [rec["req"] for rec in self._prefilled.values()]
 
     def _pool_leaf_names(self) -> list[str]:
-        """Tree-path names of the pool's K/V leaves, in the flatten
-        order kv_block_gather emits — the payload's integrity tags."""
+        """Tree-path names of the pool's leaves (K/V codes plus, on an
+        int8 pool, the scale planes), in the flatten order
+        kv_block_gather emits — the payload's integrity tags."""
         return ["/".join(str(getattr(p, "key", p)) for p in path)
                 for path, leaf in
                 jax.tree_util.tree_leaves_with_path(self._cache)
-                if _leaf_name(path) in ("cached_key", "cached_value")]
+                if _leaf_name(path) in POOL_LEAF_AXIS]
 
     def _gather_blocks(self, blocks) -> list:
         """Run the ONE fixed-shape gather program over ``blocks`` (ids
@@ -1386,7 +1538,7 @@ class ServingEngine:
         for name, leaf in zip(self._pool_leaf_names(), gathered):
             a = np.asarray(leaf)  # host sync
             out.append((name, np.take(a, np.arange(nb),
-                                      axis=a.ndim - 4)))
+                                      axis=_pool_block_axis(name, a.ndim))))
         self._progress += 1
         return out
 
@@ -1398,8 +1550,8 @@ class ServingEngine:
         ids = np.zeros(self.cfg.kv_pages, np.int32)
         ids[:nb] = blocks
         padded = []
-        for a in arrays:
-            axis = a.ndim - 4
+        for name, a in zip(self._pool_leaf_names(), arrays):
+            axis = _pool_block_axis(name, a.ndim)
             pad = [(0, 0)] * a.ndim
             pad[axis] = (0, self.cfg.kv_pages - a.shape[axis])
             padded.append(jnp.asarray(np.pad(a, pad)))
@@ -1432,7 +1584,8 @@ class ServingEngine:
             true_len=true_len, block_size=self.block_size,
             max_new_tokens=req.max_new_tokens, sampling=req.sampling,
             stop_ids=tuple(req.stop_ids),
-            leaves=self._gather_blocks(self._slot_blocks[slot][:nb]))
+            leaves=self._gather_blocks(self._slot_blocks[slot][:nb]),
+            kv_dtype=self.kv_dtype)
         self._release_slot(slot)
         req.slot = None
         req.parked = False
@@ -1461,6 +1614,19 @@ class ServingEngine:
             raise ValueError(
                 "import_kv_blocks does not compose with spec_k > 0 "
                 "(the draft pool is not on the KV stream)")
+        if payload.wire_version != KV_WIRE_VERSION:
+            raise ValueError(
+                f"KV payload wire_version {payload.wire_version} != "
+                f"engine wire_version {KV_WIRE_VERSION} — the sender "
+                f"speaks a different KV stream schema; upgrade both "
+                f"ends before disaggregating")
+        if payload.kv_dtype != self.kv_dtype:
+            raise ValueError(
+                f"payload kv_dtype {payload.kv_dtype!r} != engine "
+                f"kv_dtype {self.kv_dtype!r} — an int8 payload cannot "
+                f"be scattered into a bf16 pool (or vice versa); run "
+                f"prefill- and decode-role replicas with the same "
+                f"kv_dtype")
         if payload.block_size != self.block_size:
             raise ValueError(
                 f"payload block_size {payload.block_size} != engine "
@@ -1551,7 +1717,8 @@ class ServingEngine:
         payload = PrefixBlockPayload(
             tokens=tokens[:len(blocks) * self.block_size].copy(),
             block_size=self.block_size,
-            leaves=self._gather_blocks(blocks))
+            leaves=self._gather_blocks(blocks),
+            kv_dtype=self.kv_dtype)
         self._stats["kv_stream_bytes"] += payload.nbytes
         return payload
 
@@ -1563,6 +1730,8 @@ class ServingEngine:
         ship just means this replica prefills the prefix itself."""
         if (not self.paged or self._radix is None or self.spec_k
                 or payload.block_size != self.block_size
+                or payload.kv_dtype != self.kv_dtype
+                or payload.wire_version != KV_WIRE_VERSION
                 or [n for n, _ in payload.leaves]
                 != self._pool_leaf_names()):
             return 0
@@ -1575,8 +1744,9 @@ class ServingEngine:
         fresh = self._alloc_blocks(nb - m)
         if fresh is None:
             return 0
-        suffix = [np.take(a, np.arange(m, nb), axis=a.ndim - 4)
-                  for _, a in payload.leaves]
+        suffix = [np.take(a, np.arange(m, nb),
+                          axis=_pool_block_axis(n, a.ndim))
+                  for n, a in payload.leaves]
         self._scatter_blocks(fresh, suffix)
         self._radix.insert(tokens[:nb * self.block_size],
                            matched + fresh, remote=True)
@@ -1767,10 +1937,16 @@ class ServingEngine:
                                        / st["draft_tokens"], 4)
                                  if st["draft_tokens"] else None))
                         if self.spec_k else {})
+                per_block = self.kv_hbm_bytes // self.num_blocks
                 self.telemetry.pool(
                     kv_hbm_bytes=self.kv_hbm_bytes,
                     block_size=self.block_size,
                     num_blocks=self.num_blocks,
+                    kv_dtype=self.kv_dtype,
+                    kv_bytes_resident=st["peak_blocks_used"] * per_block,
+                    kv_tokens_capacity=(self._alloc.usable
+                                        * self.block_size),
+                    retired_blocks=st["retired_blocks"],
                     prefill_chunks=st["prefill_chunks"],
                     preemptions=st["preemptions"],
                     prefix_hit_tokens=st["prefix_hit_tokens"],
@@ -1836,7 +2012,10 @@ class ServingEngine:
             + [f"{k}={v!r}" for k, v in sorted(kw_statics.items())])
         cfg_hash = (f"slots={self.num_slots};bucket={self.bucket};"
                     f"block={self.block_size};blocks={self.num_blocks};"
-                    f"spec_k={self.spec_k}")
+                    f"spec_k={self.spec_k};kvd={self.kv_dtype};"
+                    f"sink={self.kv_sink_tokens};"
+                    f"win={self.kv_window_tokens};"
+                    f"pattn={self.paged_attn}")
 
         def compile_fn():
             return jit_fn.lower(*statics, *args, **kw_statics).compile()
@@ -1989,6 +2168,7 @@ class ServingEngine:
             # block-hash frontier, and the cross-replica hit counters
             out["parked"] = len(self._prefilled)
             out["block_size"] = self.block_size
+            out["kv_dtype"] = self.kv_dtype
             out["remote_hit_tokens"] = self._stats["remote_hit_tokens"]
             out["admitted_tokens"] = self._stats["admitted_tokens"]
             if self._radix is not None:
@@ -2034,6 +2214,11 @@ class ServingEngine:
                            admissions=0, admitted_tokens=0,
                            prefix_hit_tokens=0, prefill_chunks=0,
                            preemptions=0, block_used_sum=0.0,
+                           # KV-compression counters (ISSUE 13):
+                           # high-water pool occupancy in blocks (the
+                           # kv_bytes_resident numerator) and blocks
+                           # retired mid-stream by the sliding window
+                           peak_blocks_used=0, retired_blocks=0,
                            # disaggregation counters (ISSUE 12; stay 0
                            # colocated)
                            remote_hit_tokens=0, kv_exports=0,
@@ -2089,6 +2274,23 @@ class ServingEngine:
         if self.paged:
             out["block_size"] = self.block_size
             out["num_blocks"] = self.num_blocks
+            # KV-compression telemetry (ISSUE 13): the pool's storage
+            # dtype, its token capacity after the reserved trash block,
+            # the high-water HBM actually resident in KV blocks
+            # (peak blocks x bytes/block, scale planes included), and
+            # how many blocks the sliding window retired mid-stream
+            out["kv_dtype"] = self.kv_dtype
+            out["kv_tokens_capacity"] = (self._alloc.usable
+                                         * self.block_size)
+            out["kv_bytes_resident"] = (
+                st["peak_blocks_used"]
+                * (self.kv_hbm_bytes // self.num_blocks))
+            out["peak_blocks_used"] = st["peak_blocks_used"]
+            out["retired_blocks"] = st["retired_blocks"]
+            if self.kv_window_tokens:
+                out["kv_window_tokens"] = self.kv_window_tokens
+                out["kv_sink_tokens"] = self.kv_sink_tokens
+            out["paged_attn"] = self.paged_attn
             out["prefill_chunks"] = st["prefill_chunks"]
             out["preemptions"] = st["preemptions"]
             out["block_utilization"] = (

@@ -205,7 +205,7 @@ def _serving_section(run_dir: str) -> list[str]:
     lines.append(f"  {'rank':>4}  {'reqs':>5}  {'ttft p50':>9}  "
                  f"{'hit tok':>8}  {'hit rate':>8}  {'chunks':>6}  "
                  f"{'preempt':>7}  {'acc rate':>8}  {'cached blk':>10}  "
-                 f"{'kv hbm':>9}")
+                 f"{'kv hbm':>9}  {'kv resident':>11}")
     for rank, rows in sorted(rows_by_rank.items()):
         reqs = [r for r in rows if r.get("kind") == "request"]
         pool = next((r for r in reversed(rows)
@@ -230,9 +230,16 @@ def _serving_section(run_dir: str) -> list[str]:
         acc = f"{accepted / drafted:.2%}" if drafted else "-"
         cached = pool.get("cached_blocks", "-") if pool else "-"
         hbm = _fmt_bytes(pool.get("kv_hbm_bytes")) if pool else "-"
+        # KV compression (ISSUE 13): high-water bytes actually resident
+        # in KV blocks (scale planes included) — against "kv hbm" (the
+        # allocated pool) this reads as the compression/occupancy win
+        resident = (_fmt_bytes(pool.get("kv_bytes_resident"))
+                    if pool and pool.get("kv_bytes_resident") is not None
+                    else "-")
         lines.append(f"  {rank:>4}  {len(reqs):>5}  {p50:>9}  "
                      f"{hit_tok:>8}  {rate:>8}  {chunks:>6}  "
-                     f"{preempt:>7}  {acc:>8}  {cached!s:>10}  {hbm:>9}")
+                     f"{preempt:>7}  {acc:>8}  {cached!s:>10}  {hbm:>9}  "
+                     f"{resident:>11}")
     pools = [r for rows in rows_by_rank.values() for r in rows
              if r.get("kind") == "pool"]
     if pools:
@@ -242,11 +249,18 @@ def _serving_section(run_dir: str) -> list[str]:
         hits = sum(r.get("hits") or 0 for r in pools)
         lookups = sum(r.get("lookups") or 0 for r in pools)
         evictions = sum(r.get("evictions") or 0 for r in pools)
+        # effective capacity: tokens the pool can hold at its storage
+        # dtype — the same HBM backs ~1.9x the tokens at int8
+        cap = p.get("kv_tokens_capacity")
+        eff = (f", capacity {cap} tokens @ {p.get('kv_dtype', 'bf16')}"
+               if cap else "")
+        retired = sum(r.get("retired_blocks") or 0 for r in pools)
+        ret = f", {retired} blocks window-retired" if retired else ""
         lines.append(
             f"  pool: {p.get('num_blocks', '-')} x "
             f"{p.get('block_size', '-')}-token blocks, "
             f"cache {hits}/{lookups} lookups hit, "
-            f"{evictions} evictions")
+            f"{evictions} evictions{eff}{ret}")
         if any(r.get("spec_k") for r in pools):
             drafted = sum(r.get("draft_tokens") or 0 for r in pools)
             accepted = sum(r.get("accepted_tokens") or 0 for r in pools)

@@ -338,6 +338,146 @@ def test_import_validation_walls():
 
 
 # ----------------------------------------------------------------------
+# compressed (int8) pools over the KV stream (ISSUE 13)
+
+
+def _colocated_int8_want(prompts, news, samplings=None):
+    """Reference streams from an uninterrupted colocated int8 engine —
+    the int8 handoff's bitwise anchor (generate() is the bf16 oracle;
+    a quantized pool is its own exactness contract)."""
+    samplings = samplings or [None] * len(prompts)
+    colo = _engine(kv_dtype="int8")
+    want = []
+    for p, n, s in zip(prompts, news, samplings):
+        r = colo.submit(p, max_new_tokens=n,
+                        sampling=s or SamplingParams())
+        colo.run_until_idle()
+        want.append(list(r.new_tokens))
+    colo.close()
+    return want
+
+
+def test_kv_roundtrip_int8_compressed_blocks():
+    """ISSUE 13 acceptance: the handoff round-trips COMPRESSED blocks
+    exactly — int8 codes and their fp32 scale planes ride the same
+    pool-leaf path — so the importer's stream is bitwise-equal to an
+    uninterrupted colocated int8 engine's, greedy AND seeded, at
+    block-grid-straddling prompt lengths; the payload advertises its
+    dtype and wire version and carries the scale leaves."""
+    rng = np.random.default_rng(33)
+    lens, news = [7, 8, 9, 17], [6, 6, 6, 6]
+    prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+               for m in lens]
+    samplings = [None, SamplingParams(temperature=0.8, top_k=10, seed=5),
+                 None, SamplingParams(temperature=0.7, top_k=8, seed=9)]
+    want = _colocated_int8_want(prompts, news, samplings)
+    src, dst = _engine(kv_dtype="int8"), _engine(kv_dtype="int8")
+    handles = [src.submit(p, max_new_tokens=n,
+                          sampling=s or SamplingParams(),
+                          prefill_only=True)
+               for p, n, s in zip(prompts, news, samplings)]
+    # peek at one payload before the batch drive: the self-description
+    # a mismatched receiver rejects on, plus the scale planes
+    for _ in range(100):
+        src.step()
+        if src.parked_requests:
+            break
+    req0 = src.parked_requests[0]
+    peek = src.export_kv_blocks(req0)
+    assert peek.kv_dtype == "int8"
+    assert peek.wire_version == serving_engine.KV_WIRE_VERSION
+    names = [n.rsplit("/", 1)[-1] for n, _ in peek.leaves]
+    assert "cached_key_scale" in names and "cached_value_scale" in names
+    codes = dict(zip(names, (a for _, a in peek.leaves)))
+    assert codes["cached_key"].dtype == np.int8
+    assert codes["cached_key_scale"].dtype == np.float32
+    # the wire codec keeps all of it bit-exact
+    back = kv_payload_from_wire(kv_payload_to_wire(peek))
+    assert back.kv_dtype == "int8"
+    assert back.wire_version == peek.wire_version
+    out0 = dst.import_kv_blocks(back)
+    assert out0 is not None
+    rest = [h for h in handles if h.id != req0.id]
+    moved = _handoff_all(src, dst, rest)
+    dst.run_until_idle()
+    outs = {req0.id: out0, **{i: o for i, (_, o) in moved.items()}}
+    for h, w in zip(handles, want):
+        out = outs[h.id]
+        assert out.finish_reason == "length"
+        assert list(out.new_tokens) == w, f"request {h.id}"
+    src.close()
+    dst.close()
+
+
+def test_import_rejects_dtype_and_version_mismatch():
+    """A bf16 replica must REFUSE an int8 payload (scattering codes
+    into a bf16 pool would serve garbage) with a clear error naming
+    both dtypes, and any engine refuses a stale wire version; the
+    best-effort prefix-ship path declines (0 blocks) instead of
+    raising."""
+    src = _engine(kv_dtype="int8")
+    rng = np.random.default_rng(35)
+    p = rng.integers(0, CFG.vocab_size, (9,)).astype(np.int32)
+    src.submit(p, max_new_tokens=5, prefill_only=True)
+    for _ in range(100):
+        src.step()
+        if src.parked_requests:
+            break
+    payload = src.export_kv_blocks(src.parked_requests[0])
+    bf16 = _engine()
+    with pytest.raises(ValueError, match="kv_dtype 'int8'"):
+        bf16.import_kv_blocks(payload)
+    dst8 = _engine(kv_dtype="int8")
+    with pytest.raises(ValueError, match="wire_version"):
+        dst8.import_kv_blocks(
+            dataclasses.replace(payload, wire_version=1))
+    # prefix shipping is best-effort: mismatches decline, never raise
+    ship = src.export_prefix_blocks(p)
+    assert ship is not None and ship.kv_dtype == "int8"
+    assert bf16.import_prefix_blocks(ship) == 0
+    assert dst8.import_prefix_blocks(
+        dataclasses.replace(ship, wire_version=1)) == 0
+    # the untampered payload still lands on the matching pool
+    out = dst8.import_kv_blocks(payload)
+    assert out is not None
+    dst8.run_until_idle()
+    assert out.finish_reason == "length"
+    src.close()
+    bf16.close()
+    dst8.close()
+
+
+def test_fleet_prefix_ships_int8_blocks():
+    """Fleet prefix steering over COMPRESSED pools: an int8 fleet ships
+    int8 blocks + scales to the overflow sibling, which admits through
+    them as remote hits — every stream bitwise-equal to the colocated
+    int8 engine."""
+    rng = np.random.default_rng(37)
+    system = rng.integers(0, CFG.vocab_size, (16,)).astype(np.int32)
+    tails = [rng.integers(0, CFG.vocab_size, (3 + i,)).astype(np.int32)
+             for i in range(5)]
+    prompts = [system] + [np.concatenate([system, t]) for t in tails]
+    want = _colocated_int8_want(prompts, [4] * len(prompts))
+    model, params, _ = _setup()
+    router = ReplicaRouter(
+        model, params, replicas=2, roles=["both", "both"],
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16, block_size=8,
+                           kv_dtype="int8"),
+        warmup_lens=(16, 32))
+    router.warmup()
+    leader = router.submit(prompts[0], max_new_tokens=4)
+    router.run_until_idle()
+    sibs = [router.submit(p, max_new_tokens=4) for p in prompts[1:]]
+    router.run_until_idle()
+    s = router.summary()
+    assert s["prefix_ships"] >= 1
+    assert s["cross_replica_hit_rate"] > 0
+    for r, w in zip([leader] + sibs, want):
+        assert list(r.tokens) == w, f"request {r.id} (hops {r.replicas})"
+    router.close()
+
+
+# ----------------------------------------------------------------------
 # router-level disaggregation
 
 
@@ -546,5 +686,36 @@ def test_subprocess_disagg_e2e():
             np.testing.assert_array_equal(
                 np.asarray(r.tokens), _ref(p, 6)[p.size:],
                 err_msg=f"request {r.id}")
+    finally:
+        router.close()
+
+
+def test_subprocess_disagg_int8_e2e():
+    """ISSUE 13 over the real wire: an int8-pool prefill worker hands
+    compressed blocks (codes + scale planes, wire_version 2) to an
+    int8-pool decode worker over the line-JSON subprocess transport —
+    streams bitwise-equal to the colocated int8 engine's."""
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+               for m in (5, 9, 12)]
+    want = _colocated_int8_want(prompts, [6] * 3)
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 3, "prefill_bucket": 16,
+                       "block_size": 8, "kv_dtype": "int8"}}
+    router = ReplicaRouter(workers=[spec, spec],
+                           roles=[ROLE_PREFILL, ROLE_DECODE],
+                           warmup_lens=(16, 32), faults=None)
+    try:
+        router.warmup()
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        s = router.summary()
+        assert s["handoffs"] == 3 and s["handoff_failures"] == 0
+        for r, w in zip(reqs, want):
+            assert r.finish_reason == "length"
+            assert r.replicas == [0, 1]
+            assert list(r.tokens) == w, f"request {r.id}"
     finally:
         router.close()

@@ -193,18 +193,103 @@ def test_paged_attention_trash_garbage_is_masked():
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
-def test_paged_flash_matches_reference():
+@pytest.mark.parametrize("lengths", [
+    (5, 17, 40, 64),       # the original mixed-ragged set
+    (1, 7, 9, 23),         # every length off the block grid (bs=8),
+                           # final block 1..7 rows full
+    (8, 15, 16, 63),       # exact boundary, last-row-of-block, and the
+                           # last row of the final block
+])
+def test_paged_flash_matches_reference(lengths):
     """The Pallas pool-native twin (scalar-prefetched block tables, no
     gathered HBM copy) matches the reference gather to online-softmax
-    tolerance, GQA included."""
+    tolerance, GQA included — including lengths NOT multiples of
+    block_size, where the final block is only partially filled and the
+    kernel's in-block masking does the cut."""
     from pytorchdistributed_tpu.ops.pallas_attention import (
         paged_flash_attention,
     )
 
-    q, pk, pv, tbl, lens, _, _ = _paged_fixture((5, 17, 40, 64), kvh=2)
+    q, pk, pv, tbl, lens, _, _ = _paged_fixture(lengths, kvh=2)
     ref = paged_attention(q, pk, pv, tbl, lens)
     got = paged_flash_attention(q[:, 0], pk, pv, tbl, lens)
     np.testing.assert_allclose(np.asarray(ref[:, 0]), np.asarray(got),
+                               atol=2e-5, rtol=2e-5)
+
+
+def _quantize_fixture_pool(pk, pv):
+    from pytorchdistributed_tpu.ops.quant import kv_quantize
+
+    kc, ks = kv_quantize(pk)
+    vc, vs = kv_quantize(pv)
+    return kc, ks, vc, vs
+
+
+@pytest.mark.parametrize("lengths", [(5, 17, 40, 64), (1, 9, 23, 63)])
+def test_paged_flash_int8_matches_reference(lengths):
+    """The ISSUE 13 compressed hot path: the Pallas kernel reading the
+    int8 pool + fp32 scale planes matches the reference gather running
+    the SAME canonical dequant (ops.quant.kv_dequantize) to
+    online-softmax tolerance — the tolerance-pinned int8 twin."""
+    from pytorchdistributed_tpu.ops.pallas_attention import (
+        paged_flash_attention,
+    )
+
+    q, pk, pv, tbl, lens, _, _ = _paged_fixture(lengths, kvh=2)
+    kc, ks, vc, vs = _quantize_fixture_pool(pk, pv)
+    ref = paged_attention(q, kc, vc, tbl, lens, k_scale=ks, v_scale=vs)
+    got = paged_flash_attention(q[:, 0], kc, vc, tbl, lens,
+                                k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(ref[:, 0]), np.asarray(got),
+                               atol=2e-5, rtol=2e-5)
+    # and the quantization error itself is bounded: int8 per-(token,
+    # head) absmax scaling stays close to the fp32 oracle
+    full = paged_attention(q, pk, pv, tbl, lens)
+    np.testing.assert_allclose(np.asarray(full[:, 0]), np.asarray(got),
+                               atol=0.05, rtol=0.05)
+
+
+def test_paged_flash_sink_window_matches_reference():
+    """Sink + sliding-window masking agrees between the kernel and the
+    reference gather (fp32 and int8 pools): only the first sink_tokens
+    and the trailing window_tokens positions contribute, and a
+    fully-dead middle block's content is irrelevant (the kernel skips
+    its DMA; the engine retires it back to the allocator)."""
+    from pytorchdistributed_tpu.ops.pallas_attention import (
+        paged_flash_attention,
+    )
+
+    lengths = (40, 64, 23)
+    q, pk, pv, tbl, lens, _, _ = _paged_fixture(lengths, kvh=2)
+    kw = dict(sink_tokens=8, window_tokens=16)
+    ref = paged_attention(q, pk, pv, tbl, lens, **kw)
+    got = paged_flash_attention(q[:, 0], pk, pv, tbl, lens, **kw)
+    np.testing.assert_allclose(np.asarray(ref[:, 0]), np.asarray(got),
+                               atol=2e-5, rtol=2e-5)
+    # windowing changed the answer (the mask is real)
+    full = paged_attention(q, pk, pv, tbl, lens)
+    assert not np.allclose(np.asarray(full[:, 0]), np.asarray(got),
+                           atol=1e-3)
+    # dead middle blocks are never read: poison them, nothing moves
+    bs = pk.shape[1]
+    tbl_np = np.asarray(tbl).copy()
+    for s, n in enumerate(lengths):
+        for bi in range(tbl_np.shape[1]):
+            if bi * bs >= 8 and (bi + 1) * bs <= n - 16 + 1:
+                tbl_np[s, bi] = 0  # retire: point at trash
+    pk = pk.at[0].set(1e6)
+    pv = pv.at[0].set(-1e6)
+    got2 = paged_flash_attention(q[:, 0], pk, pv, jnp.asarray(tbl_np),
+                                 lens, **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(got2),
+                               atol=2e-5, rtol=2e-5)
+    # int8 pool through the same mask
+    kc, ks, vc, vs = _quantize_fixture_pool(pk, pv)
+    refq = paged_attention(q, kc, vc, tbl, lens, k_scale=ks, v_scale=vs,
+                           **kw)
+    gotq = paged_flash_attention(q[:, 0], kc, vc, tbl, lens,
+                                 k_scale=ks, v_scale=vs, **kw)
+    np.testing.assert_allclose(np.asarray(refq[:, 0]), np.asarray(gotq),
                                atol=2e-5, rtol=2e-5)
 
 
@@ -532,10 +617,210 @@ def test_report_cli_renders_serving_table(tmp_path):
     assert "serving (per rank" in out
     assert "prefix cache" in out
     assert "-token blocks" in out
+    # KV compression columns (ISSUE 13): high-water resident bytes and
+    # the pool's effective capacity at its storage dtype
+    assert "kv resident" in out
+    assert "tokens @ bf16" in out
     # the hit tokens column is non-zero: reuse reached the report
     import re
     m = re.search(r"^\s+0\s+\d+\s+\S+ ms\s+(\d+)", out, re.M)
     assert m and int(m.group(1)) > 0, out
+
+
+# ---------------------------------------------------------------------------
+# KV compression (ISSUE 13): int8 pool, window retirement, Pallas default
+
+
+def test_allocator_midstream_decref_recycles():
+    """ISSUE 13 regression: blocks decref'd MID-STREAM (window
+    retirement) go straight back onto the free list and are handed out
+    again while the retiring owner still holds its other blocks —
+    and once everyone exits, check_leaks is clean."""
+    a = BlockAllocator(8, 4)
+    mine = a.alloc(5)
+    retired = mine[1:3]
+    for b in retired:
+        assert a.decref(b)          # mid-stream retirement frees NOW
+    assert a.free_count == 4
+    theirs = a.alloc(4)             # a newcomer is backed by them
+    assert theirs is not None and set(retired) <= set(theirs)
+    for b in [mine[0], *mine[3:], *theirs]:
+        a.decref(b)
+    a.check_leaks()                 # stream finish leaves no residue
+
+
+def test_parity_paged_engine_pallas():
+    """The Pallas decode tick forced on CPU (interpret=True): greedy
+    token streams match the gather engine's exactly on this seeded
+    mixed workload. (Flash reassociates the softmax, so the pinned
+    cross-engine contract is token equality on a deterministic
+    backend; the BITWISE-vs-generate() contract stays on gather.)"""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    outs = {}
+    for mode in ("gather", "pallas"):
+        engine = ServingEngine(model, params, num_slots=3,
+                               prefill_bucket=16, block_size=8,
+                               paged_attn=mode)
+        assert engine.paged_attn == mode
+        assert engine.summary()["paged_attn"] == mode
+        engine.warmup(prompt_lens=(8, 16))
+        prompts, news = _mixed_requests(cfg.vocab_size, n=4)
+        rs = []
+        for p, n in zip(prompts, news):
+            rs.append(engine.submit(p, max_new_tokens=n))
+            engine.step()
+        engine.run_until_idle()
+        outs[mode] = [list(r.new_tokens) for r in rs]
+        engine.close()
+    assert outs["pallas"] == outs["gather"]
+
+
+def test_parity_paged_engine_int8_readers_agree():
+    """kv_dtype="int8" end-to-end: blocks are quantized at write time
+    and both pool readers — the reference gather and the Pallas kernel
+    — decode the SAME greedy streams from the same compressed pool
+    (one canonical dequant, ops.quant.kv_dequantize, pinned across
+    readers). The int8 pool is smaller than bf16's at equal blocks."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    outs, hbm = {}, {}
+    for mode in ("gather", "pallas"):
+        engine = ServingEngine(model, params, num_slots=2,
+                               prefill_bucket=16, block_size=8,
+                               kv_dtype="int8", paged_attn=mode)
+        assert engine.summary()["kv_dtype"] == "int8"
+        engine.warmup(prompt_lens=(8, 16))
+        prompts, news = _mixed_requests(cfg.vocab_size, seed=5, n=3)
+        rs = []
+        for p, n in zip(prompts, news):
+            rs.append(engine.submit(p, max_new_tokens=n))
+            engine.step()
+        engine.run_until_idle()
+        assert all(r.finish_reason == "length" for r in rs)
+        outs[mode] = [list(r.new_tokens) for r in rs]
+        hbm[mode] = engine.kv_hbm_bytes
+        engine.close()
+    assert outs["pallas"] == outs["gather"]
+    bf16 = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                         block_size=8)
+    # int8 codes + fp32 scale planes vs bf16: (d + 4) / 2d bytes per
+    # token-head — a real shrink at any head_dim > 4
+    assert hbm["gather"] < bf16.kv_hbm_bytes
+    bf16.close()
+
+
+def test_window_retirement_recycles_blocks_midstream():
+    """Sink+window streams hand their fully-dead middle blocks back to
+    the pool WHILE STILL DECODING: two long streams that would
+    overflow the pool at full attention (and preempt) instead run to
+    completion preemption-free on the blocks retirement recycles —
+    and close()'s leak invariant still passes."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    params = _init(model)
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+               for _ in range(2)]
+
+    def run(**kw):
+        engine = ServingEngine(model, params, num_slots=2,
+                               prefill_bucket=16, block_size=8,
+                               num_blocks=21, **kw)
+        engine.warmup(prompt_lens=(16,))
+        rs = [engine.submit(p, max_new_tokens=80) for p in prompts]
+        engine.run_until_idle()
+        assert all(r.finish_reason == "length" for r in rs)
+        assert all(len(r.new_tokens) == 80 for r in rs)
+        s = engine.summary()
+        engine.close()
+        return s
+
+    full = run()
+    win = run(kv_sink_tokens=8, kv_window_tokens=32)
+    # full attention can't hold 2 x 96 tokens in 20 usable blocks
+    assert full["preemptions"] >= 1
+    # windowed: middle blocks retire back mid-stream, nobody preempts
+    assert win["preemptions"] == 0
+    assert win["retired_blocks"] > 0
+    assert win["peak_blocks_used"] < full["peak_blocks_used"]
+    assert win["kv_window_tokens"] == 32 and win["kv_sink_tokens"] == 8
+
+
+def test_zero_recompiles_compressed_path():
+    """The ISSUE 13 tripwire: steady-state decode on the int8 +
+    windowed engine — block growth, MID-STREAM window retirement,
+    retire + readmit — triggers ZERO retraces and zero recompiles
+    after warmup (scale planes and the static window mask are baked
+    into the compiled pair, never re-traced per step)."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    engine = ServingEngine(model, _init(model), num_slots=2,
+                           prefill_bucket=16, block_size=8,
+                           kv_dtype="int8", kv_sink_tokens=8,
+                           kv_window_tokens=16)
+    engine.warmup(prompt_lens=(8, 16))
+    traces = dict(serving_engine.TRACE_COUNTS)
+    sizes = (paged_prefill_chunk._cache_size(),
+             paged_decode_tick._cache_size())
+    rng = np.random.default_rng(13)
+    for i in range(6):
+        p = rng.integers(0, cfg.vocab_size,
+                         (int(rng.integers(1, 16)),)).astype(np.int32)
+        engine.submit(p, max_new_tokens=int(rng.integers(25, 40)))
+        engine.step()
+    engine.run_until_idle()
+    s = engine.summary()
+    assert s["retired_blocks"] > 0, "retirement never exercised"
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    assert (paged_prefill_chunk._cache_size(),
+            paged_decode_tick._cache_size()) == sizes
+    engine.close()
+
+
+def test_paged_attn_env_and_auto_resolution(monkeypatch):
+    """PTD_PAGED_ATTN seeds the default; "auto" resolves per backend
+    (pallas on TPU, gather elsewhere — this suite runs on CPU); an
+    explicit constructor arg beats the env."""
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+
+    def attn(**kw):
+        e = ServingEngine(model, params, num_slots=2, block_size=8, **kw)
+        mode = e.paged_attn
+        e.close()
+        return mode
+
+    monkeypatch.delenv("PTD_PAGED_ATTN", raising=False)
+    assert attn() == "gather"                      # auto on CPU
+    monkeypatch.setenv("PTD_PAGED_ATTN", "pallas")
+    assert attn() == "pallas"                      # env seeds default
+    assert attn(paged_attn="gather") == "gather"   # arg beats env
+    monkeypatch.setenv("PTD_PAGED_ATTN", "auto")
+    assert attn() == "gather"
+
+
+def test_kv_compression_validations():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    with pytest.raises(ValueError, match="paged-engine knobs"):
+        ServingEngine(model, params, num_slots=2, kv_dtype="int8")
+    with pytest.raises(ValueError, match="paged_attn"):
+        ServingEngine(model, params, num_slots=2, block_size=8,
+                      paged_attn="bogus")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServingEngine(model, params, num_slots=2, block_size=8,
+                      kv_dtype="fp8")
+    with pytest.raises(ValueError, match="multiple"):
+        ServingEngine(model, params, num_slots=2, block_size=8,
+                      kv_window_tokens=12)
+    with pytest.raises(ValueError, match="kv_window_tokens"):
+        ServingEngine(model, params, num_slots=2, block_size=8,
+                      kv_sink_tokens=8)
 
 
 def test_paged_validations():
