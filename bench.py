@@ -1831,6 +1831,114 @@ def bench_scaling_sim() -> dict:
     return result
 
 
+def bench_moe() -> dict:
+    """Expert-parallel MoE training throughput (ISSUE 14): a GPT-2-shaped
+    Switch/top-k MoE LM on a dp x expert mesh, trained through the
+    explicit all_to_all dispatch/combine (ops/overlap.expert_a2a_ffn).
+
+    Three legs on the SAME model/batch:
+      * headline — the a2a path with capacity chunking (``moe_chunks``
+        from PTD_MOE_CHUNKS, default 2): dispatch/combine exchanges
+        pipelined behind the expert matmuls;
+      * overlap OFF — ``moe_dispatch="dense"``: the auto-partitioned
+        one-hot einsums with a GLOBAL capacity buffer, i.e. the path
+        every token took before the explicit exchange existed;
+      * chunks=1 — the a2a path without pipelining, isolating the
+        chunking term from the grouped-dispatch term.
+
+    Stamps tokens/s for each leg, the a2a comm bytes of the compiled
+    step (telemetry a2a_bytes_per_step), and the expert overflow
+    fraction read from a diagnostics-enabled twin of the step. Knobs:
+    PTD_MOE_{EXPERTS,TOP_K,CAPACITY,CHUNKS,DISPATCH,EP}, PTD_BENCH_BS/
+    PTD_BENCH_SEQ, PTD_QUANT. On the CPU sim the numbers are regression
+    pins (the grouped dispatch term dominates); the chunk-overlap
+    multiplier needs a chip's async collectives."""
+    import os
+    import sys
+
+    import optax
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.runtime.mesh import MeshConfig, create_mesh
+    from pytorchdistributed_tpu.training import (
+        Trainer,
+        moe_token_cross_entropy_loss,
+    )
+
+    import jax
+    experts = int(os.environ.get("PTD_MOE_EXPERTS", 8))
+    top_k = int(os.environ.get("PTD_MOE_TOP_K", 1))
+    cf = float(os.environ.get("PTD_MOE_CAPACITY", 1.25))
+    chunks = int(os.environ.get("PTD_MOE_CHUNKS", 2))
+    batch_size = int(os.environ.get("PTD_BENCH_BS", 8))
+    seq_len = int(os.environ.get("PTD_BENCH_SEQ", 512))
+    ndev = jax.device_count()
+    # dp x expert: prefer a real data axis next to the expert axis (the
+    # canonical MoE training mesh); ep must divide devices AND experts
+    ep = int(os.environ.get("PTD_MOE_EP", 0)) or next(
+        (e for e in (4, 2, 8) if ndev % e == 0 and experts % e == 0), 1)
+    mesh = create_mesh(MeshConfig(data=ndev // ep, expert=ep))
+
+    def make_trainer(moe_chunks, dispatch, diagnostics=None):
+        cfg = gpt2_config(
+            "test", num_layers=4, embed_dim=256, num_heads=8,
+            mlp_dim=1024, vocab_size=2048, max_seq_len=seq_len,
+            scan_layers=False, moe_experts=experts,
+            moe_capacity_factor=cf, moe_top_k=top_k,
+            moe_chunks=moe_chunks, moe_dispatch=dispatch,
+            quant=_quant_override())
+        return Trainer(GPT2(cfg), optax.adamw(3e-4),
+                       moe_token_cross_entropy_loss, mesh=mesh,
+                       strategy="dp", log_every=10**9,
+                       diagnostics=diagnostics)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, 2048, (batch_size, seq_len)).astype(
+            np.int32),
+        "targets": rng.integers(0, 2048, (batch_size, seq_len)).astype(
+            np.int32),
+    }
+    dispatch = os.environ.get("PTD_MOE_DISPATCH", "auto")
+    trainer = make_trainer(chunks, dispatch)
+    sec = _time_steps(trainer, batch, steps=10)
+    sec_dense = _time_steps(make_trainer(chunks, "dense"), batch, steps=10)
+    sec_c1 = (sec if chunks == 1
+              else _time_steps(make_trainer(1, dispatch), batch, steps=10))
+
+    tokens = batch_size * seq_len
+    result = {"metric": "moe_train_tokens_per_s",
+              "value": round(tokens / sec, 1), "unit": "tokens/s",
+              "mesh": {"data": ndev // ep, "expert": ep},
+              "experts": experts, "top_k": top_k, "capacity_factor": cf,
+              "chunks": chunks, "dispatch": dispatch,
+              "overlap_off_tokens_per_s": round(tokens / sec_dense, 1),
+              "overlap_speedup": round(sec_dense / sec, 3),
+              "chunks1_tokens_per_s": round(tokens / sec_c1, 1)}
+    _stamp_overrides(result, ("PTD_MOE_EXPERTS", "PTD_MOE_TOP_K",
+                              "PTD_MOE_CAPACITY", "PTD_MOE_CHUNKS",
+                              "PTD_MOE_DISPATCH", "PTD_MOE_EP",
+                              "PTD_BENCH_BS", "PTD_BENCH_SEQ",
+                              "PTD_QUANT"))
+    result = _accounting_fields(trainer, batch, result, sec)
+    try:
+        result["a2a_bytes_per_step"] = trainer.step_accounting(
+            batch).a2a_bytes_per_step
+    except Exception as e:
+        print(f"bench: a2a accounting skipped ({e})", file=sys.stderr)
+    # overflow fraction from a diagnostics-enabled twin (one extra
+    # compile; the timed legs stay diagnostics-off like every bench)
+    try:
+        diag = make_trainer(chunks, dispatch, diagnostics="scalars")
+        diag.init(batch)
+        m = diag.train_step(batch)
+        result["moe_overflow_frac"] = round(
+            float(m["diag/moe_overflow"]), 4)
+    except Exception as e:
+        print(f"bench: moe overflow probe skipped ({e})", file=sys.stderr)
+    return result
+
+
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "gpt2medium": functools.partial(bench_gpt2, "medium"),
            "longcontext": functools.partial(
@@ -1841,6 +1949,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "serve": bench_serve, "kvcompress": bench_kvcompress,
            "router": bench_router,
            "disagg": bench_disagg, "coldstart": bench_coldstart,
+           "moe": bench_moe,
            "mlp": bench_mlp, "sweep": bench_sweep,
            "scaling": bench_scaling, "scaling_sim": bench_scaling_sim}
 

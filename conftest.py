@@ -78,6 +78,16 @@ _QUICK = (
     "test_quant.py::test_parity_dp",          # int8_fwd vs bf16 loss curve
     "test_moe.py::test_single_expert_is_dense_mlp",
     "test_moe.py::test_moe_aux_loss_uniform_at_balance",
+    # expert-parallel MoE (ISSUE 14): a2a-vs-dense parity (fp32 exact,
+    # int8 tol), chunked-overlap bitwise, top-2 per-token reference +
+    # the k-major capacity-race edge, and the expert-sharded serving
+    # bitwise + zero-recompile tripwire
+    "test_moe.py::test_expert_parallel_a2a_matches_single_device",
+    "test_moe.py::test_expert_parallel_int8_parity",
+    "test_moe.py::test_moe_chunked_overlap_bitwise",
+    "test_moe.py::test_top2_matches_per_token_reference",
+    "test_moe.py::test_top2_first_choices_win_capacity_race",
+    "test_moe.py::test_moe_serving_bitwise_vs_generate_expert_sharded",
     "test_torch_import.py",                   # torch->TPU logit parity
     # telemetry subsystem: tracer/accounting/tripwire units + the
     # single-process end-to-end smoke (train with telemetry on → report);

@@ -76,6 +76,14 @@ def main():
                         help="decode-role replica count for the "
                              "disaggregated topology (see "
                              "--prefill-replicas)")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="> 0: train and serve a Switch-MoE model "
+                             "(README 'Expert parallelism') — expert "
+                             "kernels shard over an 'expert' mesh axis "
+                             "sized from the device count, training "
+                             "routes through the explicit all_to_all "
+                             "dispatch, and the engine ticks on the "
+                             "same dp x expert mesh")
     parser.add_argument("--chaos", action="store_true",
                         help="with --replicas > 1: crash replica 0 "
                              "mid-trace — watch the router redispatch "
@@ -103,10 +111,29 @@ def main():
             args.block_size = 16  # KV handoff requires the paged engine
 
     ptd.init_process_group()
-    cfg = llama_config("test", max_seq_len=64)
+    mesh, moe_kw, loss = ptd.create_mesh(), {}, token_cross_entropy_loss
+    if args.moe_experts:
+        if args.replicas > 1 or roles:
+            parser.error("--moe-experts serves through one expert-sharded "
+                         "engine (replicated/disaggregated topologies "
+                         "would need per-replica meshes)")
+        import jax
+
+        from pytorchdistributed_tpu.runtime.mesh import MeshConfig
+        from pytorchdistributed_tpu.training import (
+            moe_token_cross_entropy_loss,
+        )
+
+        ndev = jax.device_count()
+        ep = next((e for e in (4, 2, 8)
+                   if ndev % e == 0 and args.moe_experts % e == 0), 1)
+        mesh = ptd.create_mesh(MeshConfig(data=ndev // ep, expert=ep))
+        moe_kw = dict(moe_experts=args.moe_experts)
+        loss = moe_token_cross_entropy_loss
+    cfg = llama_config("test", max_seq_len=64, **moe_kw)
     model = Llama(cfg)
-    trainer = Trainer(model, optax.adamw(3e-3), token_cross_entropy_loss,
-                      mesh=ptd.create_mesh(), strategy="dp", log_every=50)
+    trainer = Trainer(model, optax.adamw(3e-3), loss,
+                      mesh=mesh, strategy="dp", log_every=50)
 
     # identity task: target[t] = token[t] — greedy serving visibly repeats
     # each prompt's last token (the learned behavior), so mixed-length
@@ -189,6 +216,7 @@ def main():
         model, params,
         num_slots=args.num_slots, prefill_bucket=16,
         block_size=args.block_size, spec_k=args.spec_k, **spec_kw,
+        mesh=mesh if args.moe_experts else None,
         telemetry_dir=args.telemetry_dir,
         compile_cache=args.compile_cache or "auto")
     engine.warmup(prompt_lens=(16,))

@@ -48,6 +48,16 @@ class ExperimentConfig:
     pp_schedule: str = "gpipe"     # gpipe | 1f1b (transformer models)
     expert: int = 1                # mesh axis for expert parallelism
     moe_experts: int = 0           # >0: Switch-MoE MLPs (transformer models)
+    moe_capacity_factor: float = 1.25  # expert slot headroom over the
+    #                                    uniform-routing load (GShard's cf)
+    moe_top_k: int = 1             # routed experts per token (1 = Switch,
+    #                                2 = GShard top-2 with gate renorm)
+    moe_every: int = 1             # MoE block cadence: every Nth block is
+    #                                MoE, others dense (needs unrolled
+    #                                layers when > 1)
+    moe_chunks: int = 1            # capacity chunks for dispatch/combine
+    #                                a2a <-> expert-matmul overlap (>1
+    #                                pipelines the exchange)
     # precision
     bf16: bool = True
     # Int8 quantized-training matmuls (ops/quant.py, the amp→bf16→int8
@@ -242,6 +252,15 @@ def _build_model(cfg: ExperimentConfig):
                pipeline_microbatches=cfg.pipeline_microbatches,
                pp_schedule=cfg.pp_schedule, moe_experts=cfg.moe_experts,
                dropout_rate=cfg.dropout_rate)
+    if cfg.moe_experts > 0:
+        tkw.update(moe_capacity_factor=cfg.moe_capacity_factor,
+                   moe_top_k=cfg.moe_top_k, moe_every=cfg.moe_every,
+                   moe_chunks=cfg.moe_chunks,
+                   # interleaving picks blocks by index — needs the
+                   # unrolled stack (transformer.py __post_init__ errors
+                   # on scan_layers + moe_every > 1)
+                   **(dict(scan_layers=False) if cfg.moe_every > 1
+                      else {}))
 
     lm_families = {
         "gpt2": (models.GPT2, models.gpt2_config),

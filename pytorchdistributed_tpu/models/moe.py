@@ -1,26 +1,43 @@
-"""Mixture-of-Experts — Switch-style top-1 routing over the "expert" mesh
-axis (SURVEY.md §2c "EP", the optional strategy; the reference has no MoE
-content at all, so the design is TPU-first rather than a port).
+"""Mixture-of-Experts — top-k routed expert FFNs over the "expert" mesh
+axis (SURVEY.md §2c "EP"; the reference has no MoE content at all, so the
+design is TPU-first rather than a port).
 
 TPU-idiomatic expert parallelism is *not* a per-token gather/scatter loop:
 
-  * routing is computed densely (router logits → top-1 → one-hot dispatch
+  * routing is computed densely (router logits → top-k → one-hot dispatch
     and combine tensors), so every shape is static and XLA can tile the
     whole thing onto the MXU;
-  * dispatch/combine are einsums against a ``[tokens, experts, capacity]``
-    one-hot — when tokens are sharded over "data" and the expert dim of the
-    stacked expert MLPs over "expert" (rule table parallel/tp.py
-    ``Logical.EXPERT → Axis.EXPERT``), XLA lowers these einsums to the
-    all_to_all exchange that GPU frameworks hand-write;
-  * each expert processes a fixed ``capacity = ceil(cf · tokens/experts)``
-    slots; overflow tokens skip the expert and ride the residual connection
-    (standard Switch behavior) — static shapes, no data-dependent control
-    flow inside jit;
-  * the Switch load-balancing auxiliary loss is sown into the "losses"
-    collection; `training.losses.moe_aux_loss` collects it.
+  * tokens are routed in **G independent groups** with per-group capacity
+    ``ceil(cf · (tokens/G)/experts)``. G defaults to one group per
+    (data × fsdp × expert) mesh shard — the GShard layout in which the
+    dispatch is a pure permutation of equal tiles, so it lowers to a
+    literal ``all_to_all`` instead of the reduce-scatter a global
+    capacity buffer forces. G = 1 (single-device / dp-only meshes)
+    reproduces the original Switch global-capacity numerics exactly;
+  * with an expert axis of size > 1 the dispatch/combine run through the
+    EXPLICIT exchange (`ops/overlap.expert_a2a_ffn`): custom_vjp inside
+    shard_map, chunked capacity pipelining of the combine a2a behind the
+    next chunk's expert matmul, and int8 payloads under ``cfg.quant`` —
+    2 a2a forward + 2 backward per MoE layer, all counted by the HLO
+    census. Elsewhere (decode, pipeline bodies, non-tiling shapes) the
+    dense einsum path runs and the auto-partitioner keeps its old job;
+  * each expert processes a fixed capacity of slots; overflow tokens skip
+    the expert and ride the residual connection (standard Switch
+    behavior) — and the overflow FRACTION is sown into the diagnostics
+    tables (``moe_overflow``, with the per-expert routing fractions as
+    ``moe_frac``) instead of failing silently;
+  * ``decode`` models route PER TOKEN (G = tokens, capacity 1): nothing
+    ever overflows and a token's routing is independent of its slot
+    neighbours, which is what keeps serving output bitwise-equal to
+    offline ``generate()`` regardless of batch composition;
+  * the Switch load-balancing auxiliary loss and the ST-MoE router
+    z-loss are sown into the "losses" collection under distinct names;
+    `training.losses.moe_token_cross_entropy_loss` applies each term's
+    own weight.
 
-Reference for the pattern (PAPERS.md): Switch Transformer (Fedus et al.),
-as realized in public JAX codebases (flaxformer/t5x-style dense dispatch).
+References (PAPERS.md): Switch Transformer (Fedus et al.) for top-1 +
+aux loss; GShard (Lepikhin et al.) for grouped dispatch + top-2; ST-MoE
+(Zoph et al.) for the router z-loss.
 """
 
 from __future__ import annotations
@@ -30,57 +47,153 @@ import math
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from pytorchdistributed_tpu.parallel.tp import Logical
+from pytorchdistributed_tpu.runtime.mesh import Axis
+
+
+def moe_groups_for(cfg, num_tokens: int, mesh=None) -> int:
+    """The routing-group count G for this config/mesh/token count.
+
+    decode → per-token groups (capacity never binds; serving stays
+    bitwise vs `generate()`). An explicit ``cfg.moe_groups`` wins next
+    (parity tests pin the sharded grouping on a single device with it).
+    Auto (0): one group per (data × fsdp × expert) shard when the expert
+    axis is real — the layout whose dispatch is a pure permutation —
+    else 1, the original global-capacity numerics."""
+    if cfg.decode:
+        return num_tokens
+    if cfg.moe_groups > 0:
+        if num_tokens % cfg.moe_groups:
+            raise ValueError(
+                f"moe_groups {cfg.moe_groups} does not divide the "
+                f"token count {num_tokens}")
+        return cfg.moe_groups
+    if mesh is None:
+        from pytorchdistributed_tpu.parallel.overlap import _ambient_mesh
+
+        mesh = _ambient_mesh()
+    if mesh is not None and mesh.shape.get(Axis.EXPERT, 1) > 1:
+        shards = (mesh.shape.get(Axis.DATA, 1)
+                  * mesh.shape.get(Axis.FSDP, 1)
+                  * mesh.shape[Axis.EXPERT])
+        if num_tokens >= shards and num_tokens % shards == 0:
+            return shards
+    return 1
 
 
 class SwitchMoE(nn.Module):
-    """Drop-in MLP replacement: top-1 routed expert FFNs.
+    """Drop-in MLP replacement: top-k routed expert FFNs.
 
     Call shape ``[batch, seq, embed] -> [batch, seq, embed]``. Expert
     kernels are stacked ``[experts, ...]`` with logical axis
-    ``Logical.EXPERT`` so the "tp" rule table shards them over the "expert"
+    ``Logical.EXPERT`` so the rule tables shard them over the "expert"
     mesh axis.
     """
 
     cfg: "TransformerConfig"  # noqa: F821 — transformer.py's config
     deterministic: bool = True
 
+    def _sow_moe_diagnostics(self, frac, overflow):
+        """Routing health into the diagnostics tables (ISSUE 6 contract:
+        gated entirely on the collection being mutable, so a
+        diagnostics-off program's HLO is untouched): ``moe_frac`` — the
+        per-expert first-choice routing fractions [e] (uniform = 1/e; a
+        collapsing router shows up as one hot column), and
+        ``moe_overflow`` — the fraction of routing assignments that lost
+        the capacity race and rode the residual."""
+        if self.is_initializing() or not self.is_mutable_collection(
+                "diagnostics"):
+            return
+        self.sow("diagnostics", "moe_frac", frac)
+        self.sow("diagnostics", "moe_overflow", overflow)
+
+    def _use_a2a(self, mesh, num_groups: int, experts: int) -> bool:
+        """Route dispatch/combine through the explicit a2a shard_map path
+        (`ops/overlap.expert_a2a_ffn`)? Mirrors site_dot_general's
+        gating: never under decode (per-token groups / single-chip) or
+        inside a pipeline stage body (already a manual region), and only
+        when the shapes tile the mesh — "a2a" intent still falls back
+        rather than erroring, "dense" opts out (the bench A/B knob)."""
+        cfg = self.cfg
+        if cfg.moe_dispatch == "dense" or cfg.decode:
+            return False
+        if getattr(cfg, "pipeline_stages", 1) > 1:
+            return False
+        from pytorchdistributed_tpu.ops.overlap import expert_a2a_applicable
+
+        return expert_a2a_applicable(num_groups, experts, mesh)
+
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
         e, d, f = cfg.moe_experts, cfg.embed_dim, cfg.ffn_dim
+        k = min(getattr(cfg, "moe_top_k", 1), e)
         b, s, _ = x.shape
         g = b * s  # token count
-        capacity = max(1, math.ceil(cfg.moe_capacity_factor * g / e))
+        from pytorchdistributed_tpu.parallel.overlap import _ambient_mesh
 
-        # -- router (fp32 for a stable softmax/argmax) -------------------
+        mesh = _ambient_mesh()
+        G = moe_groups_for(cfg, g, mesh)
+        n = g // G  # tokens per routing group
+        capacity = max(1, math.ceil(cfg.moe_capacity_factor * n / e))
+
+        # -- router (fp32 for a stable softmax/top_k) --------------------
         router_kernel = self.param(
             "router",
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02),
                 (Logical.EMBED, Logical.EXPERT)),
             (d, e), jnp.float32)
-        tokens = x.reshape(g, d)
-        logits = tokens.astype(jnp.float32) @ router_kernel     # [g, e]
+        xg = x.reshape(G, n, d)
+        xg = nn.with_logical_constraint(
+            xg, (Logical.EGROUP, None, Logical.EMBED))
+        logits = jnp.einsum("gnd,de->gne", xg.astype(jnp.float32),
+                            router_kernel)
         probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)                 # [g]
-        gate = jnp.max(probs, axis=-1)                          # [g]
-        expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
 
-        # Switch aux loss: e · Σ_e (token fraction to e) · (mean prob of e).
+        # top-k choices. lax.top_k breaks probability ties toward the
+        # LOWER expert index — deterministic, unlike a sort on floats.
+        gate, idx = lax.top_k(probs, k)                     # [G, n, k]
+        if k > 1:
+            # GShard-style renormalization over the chosen pair; k=1
+            # keeps the raw top probability (the Switch gate) so the
+            # original top-1 numerics are untouched.
+            gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [G, n, k, e]
+
+        # Switch aux loss on FIRST choices: e · Σ_e frac_e · mean_prob_e.
         # Minimized (=1) at uniform routing; sown for the loss fn to add.
-        frac = expert_onehot.mean(0)
-        aux = e * jnp.sum(frac * probs.mean(0))
+        frac = onehot[:, :, 0, :].mean((0, 1))
+        aux = e * jnp.sum(frac * probs.mean((0, 1)))
         self.sow("losses", "moe_aux", aux)
+        # ST-MoE router z-loss: mean(logsumexp(logits)²) keeps router
+        # logits small/stable. Sown under its own name — the loss fn
+        # separates it from the aux leaves and applies its own weight.
+        zloss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        self.sow("losses", "moe_zloss", zloss)
 
-        # -- dispatch: each token takes the next free slot of its expert --
-        pos = jnp.sum(jnp.cumsum(expert_onehot, axis=0) * expert_onehot,
-                      axis=-1).astype(jnp.int32) - 1            # [g]
-        kept = pos < capacity                                   # overflow→residual
-        dispatch = (expert_onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
-            pos, capacity, dtype=jnp.float32)[:, None, :]       # [g, e, c]
-        combine = dispatch * gate[:, None, None]
+        # -- capacity assignment: each choice takes its expert's next
+        # free slot, in K-MAJOR priority order — the [G, k·n] flatten
+        # puts EVERY token's first choice ahead of ANY second choice, so
+        # the cumsum race is deterministic and top-1 traffic can never be
+        # displaced by top-2 spillover (GShard's ordering).
+        oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * n, e)
+        pos = jnp.sum(jnp.cumsum(oh, axis=1) * oh,
+                      axis=-1).astype(jnp.int32) - 1        # [G, k·n]
+        kept = (pos < capacity).astype(jnp.float32)         # overflow→residual
+        disp = (oh * kept[..., None])[..., None] * jax.nn.one_hot(
+            pos, capacity, dtype=jnp.float32)[:, :, None, :]
+        disp = disp.reshape(G, k, n, e, capacity)
+        dispatch = jnp.sum(disp, axis=1)                    # [G, n, e, c]
+        combine = jnp.sum(
+            disp * gate.transpose(0, 2, 1)[..., None, None], axis=1)
+
+        # the overflow fraction, surfaced instead of silently riding the
+        # residual: 1 − (assignments that won a slot) / (all assignments)
+        overflow = 1.0 - jnp.sum(oh * kept[..., None]) / (G * k * n)
+        self._sow_moe_diagnostics(frac, overflow)
 
         # -- expert FFNs on [e, c, d] slots ------------------------------
         wi = self.param(
@@ -95,15 +208,32 @@ class SwitchMoE(nn.Module):
                 nn.initializers.normal(stddev=0.02),
                 (Logical.EXPERT, Logical.MLP, Logical.EMBED)),
             (e, f, d), cfg.param_dtype)
-        slots = jnp.einsum("gec,gd->ecd", dispatch.astype(cfg.dtype),
-                           tokens.astype(cfg.dtype))
-        slots = nn.with_logical_constraint(
-            slots, (Logical.EXPERT, None, Logical.EMBED))
-        h = nn.gelu(jnp.einsum("ecd,edf->ecf", slots, wi.astype(cfg.dtype)),
-                    approximate=cfg.gelu_approximate)
-        h = nn.with_logical_constraint(h, (Logical.EXPERT, None, Logical.MLP))
-        out_slots = jnp.einsum("ecf,efd->ecd", h, wo.astype(cfg.dtype))
-        out = jnp.einsum("gec,ecd->gd", combine.astype(cfg.dtype), out_slots)
+
+        if self._use_a2a(mesh, G, e):
+            from pytorchdistributed_tpu.ops.overlap import expert_a2a_ffn
+
+            out = expert_a2a_ffn(
+                xg.astype(cfg.dtype), dispatch.astype(cfg.dtype),
+                combine.astype(cfg.dtype), wi.astype(cfg.dtype),
+                wo.astype(cfg.dtype), mesh=mesh,
+                quant=None if cfg.quant == "none" else cfg.quant,
+                chunks=getattr(cfg, "moe_chunks", 1),
+                gelu_approx=cfg.gelu_approximate,
+                preferred_element_type=cfg.dtype)
+        else:
+            slots = jnp.einsum("gnec,gnd->gecd", dispatch.astype(cfg.dtype),
+                               xg.astype(cfg.dtype))
+            slots = nn.with_logical_constraint(
+                slots, (None, Logical.EXPERT, None, Logical.EMBED))
+            h = nn.gelu(
+                jnp.einsum("gecd,edf->gecf", slots, wi.astype(cfg.dtype)),
+                approximate=cfg.gelu_approximate)
+            h = nn.with_logical_constraint(
+                h, (None, Logical.EXPERT, None, Logical.MLP))
+            out_slots = jnp.einsum("gecf,efd->gecd", h, wo.astype(cfg.dtype))
+            out = jnp.einsum("gnec,gecd->gnd", combine.astype(cfg.dtype),
+                             out_slots)
+        out = out.reshape(g, d)
         if cfg.dropout_rate > 0:
             out = nn.Dropout(cfg.dropout_rate)(
                 out, deterministic=self.deterministic)

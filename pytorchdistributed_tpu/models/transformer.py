@@ -214,12 +214,38 @@ class TransformerConfig:
     # (the pure forward path always pipelines GPipe-style — schedules only
     # differ in where the backward interleaves).
     pp_schedule: str = "gpipe"
-    # Mixture-of-Experts (models/moe.py): >0 replaces every block's MLP with
-    # a Switch top-1 routed expert FFN bank, shardable over the "expert"
-    # mesh axis. Use losses that add the sown load-balance aux term
-    # (training.losses.moe_aux_loss).
+    # Mixture-of-Experts (models/moe.py): >0 replaces block MLPs with a
+    # top-k routed expert FFN bank, sharded over the "expert" mesh axis.
+    # Use losses that add the sown load-balance/z-loss terms
+    # (training.losses.moe_token_cross_entropy_loss).
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # 1 = Switch top-1 (raw top-prob gate); 2 = GShard-style top-2 with
+    # gates renormalized over the chosen pair. First choices always beat
+    # second choices in the capacity race (k-major cumsum ordering).
+    moe_top_k: int = 1
+    # An MoE FFN every Nth block ((i+1) % N == 0), dense MLP elsewhere.
+    # N > 1 requires scan_layers=False: the scanned stack folds every
+    # block into ONE body, so blocks cannot differ structurally.
+    moe_every: int = 1
+    # Routing groups G (per-group capacity ceil(cf · (tokens/G)/e)).
+    # 0 = auto: one group per data×fsdp×expert shard when the expert
+    # axis is > 1 — the layout whose dispatch is a pure permutation (a
+    # literal all_to_all) — else 1, the original global-capacity
+    # numerics. decode always routes per-token (capacity never binds →
+    # serving output independent of slot neighbours, the bitwise
+    # contract). Explicit values let single-device parity runs pin the
+    # sharded grouping.
+    moe_groups: int = 0
+    # "auto" routes dispatch/combine through the explicit all_to_all
+    # shard_map path (ops/overlap.expert_a2a_ffn) whenever mesh/shapes
+    # tile; "a2a" documents intent (still falls back rather than error);
+    # "dense" keeps the einsum path — the bench overlap-A/B knob.
+    moe_dispatch: str = "auto"   # auto | a2a | dense
+    # > 1 chunks the capacity dim so chunk i's combine a2a overlaps
+    # chunk i+1's expert matmuls (the rings' latency-hiding recipe on
+    # a2a). Non-dividing chunk counts degrade to monolithic.
+    moe_chunks: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -242,6 +268,25 @@ class TransformerConfig:
                              "parallelism (generate on a dp/tp mesh instead)")
         if self.decode_slots < 0:
             raise ValueError(f"decode_slots {self.decode_slots} must be >= 0")
+        if self.moe_dispatch not in ("auto", "a2a", "dense"):
+            raise ValueError(f"unknown moe_dispatch {self.moe_dispatch!r}; "
+                             f"one of ('auto', 'a2a', 'dense')")
+        if self.moe_chunks < 1 or self.moe_every < 1 or self.moe_groups < 0:
+            raise ValueError("moe_chunks/moe_every must be >= 1 and "
+                             "moe_groups >= 0")
+        if self.moe_experts > 0:
+            if self.moe_top_k not in (1, 2):
+                raise ValueError(f"moe_top_k {self.moe_top_k} must be 1 "
+                                 f"(Switch) or 2 (GShard)")
+            if self.moe_top_k > self.moe_experts:
+                raise ValueError(
+                    f"moe_top_k {self.moe_top_k} needs at least that many "
+                    f"experts (moe_experts={self.moe_experts})")
+            if self.moe_every > 1 and self.scan_layers:
+                raise ValueError(
+                    "moe_every > 1 (interleaved MoE) requires "
+                    "scan_layers=False: the scanned stack folds every "
+                    "block into one body")
         if self.decode_slots > 0 and not self.decode:
             raise ValueError("decode_slots > 0 (slot-based decode) requires "
                              "decode=True")
@@ -882,6 +927,9 @@ class TransformerBlock(nn.Module):
 
     cfg: TransformerConfig
     deterministic: bool = True
+    # None = cfg-driven (every block is MoE when moe_experts > 0); the
+    # unrolled stack passes the per-layer moe_every interleaving decision.
+    use_moe: bool | None = None
 
     def _sow_diagnostics(self, x):
         """In-graph block-boundary health stats (ISSUE 6): sow
@@ -920,7 +968,9 @@ class TransformerBlock(nn.Module):
                 _layer_norm(cfg, tag)(v).astype(cfg.dtype), "norm_out")
 
         def ffn(h):
-            if cfg.moe_experts > 0:
+            moe = cfg.moe_experts > 0 and (self.use_moe is None
+                                           or self.use_moe)
+            if moe:
                 from pytorchdistributed_tpu.models.moe import SwitchMoE
 
                 return SwitchMoE(cfg, self.deterministic, name="moe")(h)
@@ -999,8 +1049,11 @@ def make_stage_apply(cfg: TransformerConfig, *, aux: bool = False):
                 lp, j = xs
                 h, mods = block.apply({"params": lp}, h, rngs=rngs_for(j),
                                       mutable=["losses"])
-                sown = jax.tree.leaves(mods.get("losses", {}))
-                aux_acc = aux_acc + sum(jnp.mean(v) for v in sown)
+                from pytorchdistributed_tpu.training.losses import (
+                    pipeline_aux_fold,
+                )
+
+                aux_acc = aux_acc + pipeline_aux_fold(mods.get("losses", {}))
                 return (h, aux_acc), None
 
             (h, aux_sum), _ = jax.lax.scan(
@@ -1048,8 +1101,11 @@ class TransformerStack(nn.Module):
                 metadata_params={nn.PARTITION_NAME: Logical.STAGE},
             )(block(cfg, deterministic, name="block"), x, None)
         else:
+            interleave = cfg.moe_experts > 0 and cfg.moe_every > 1
             for i in range(cfg.num_layers):
-                x = block(cfg, deterministic, name=f"block_{i}")(x)
+                kw = ({"use_moe": (i + 1) % cfg.moe_every == 0}
+                      if interleave else {})
+                x = block(cfg, deterministic, name=f"block_{i}", **kw)(x)
         return x
 
     def _pipelined(self, x, deterministic: bool):

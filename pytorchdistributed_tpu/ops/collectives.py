@@ -101,6 +101,30 @@ def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):
     )
 
 
+def expert_dispatch(slots, axis_name: str):
+    """MoE dispatch all_to_all (GShard's first exchange): slot tensors
+    ``[groups_local, experts, capacity, ...]`` are re-sharded so every
+    member of the expert axis holds ALL groups' slots for ITS experts —
+    ``[groups_local·ep, experts/ep, capacity, ...]``. Tree-mapped so a
+    pre-quantized ``(int8 codes, fp32 scales)`` payload ships as one
+    logical exchange (the same composition the gather ring uses for its
+    ppermute hops). ``expert_combine`` is the exact transpose."""
+    return jax.tree.map(
+        lambda t: lax.all_to_all(t, axis_name, split_axis=1, concat_axis=0,
+                                 tiled=True),
+        slots)
+
+
+def expert_combine(slots, axis_name: str):
+    """MoE combine all_to_all: the transpose of `expert_dispatch` — expert
+    outputs ``[groups_local·ep, experts/ep, capacity, ...]`` return to the
+    group-sharded layout ``[groups_local, experts, capacity, ...]``."""
+    return jax.tree.map(
+        lambda t: lax.all_to_all(t, axis_name, split_axis=0, concat_axis=1,
+                                 tiled=True),
+        slots)
+
+
 def axis_index(axis_name: str):
     return lax.axis_index(axis_name)
 

@@ -79,7 +79,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from pytorchdistributed_tpu.ops.collectives import ring_schedule
+from pytorchdistributed_tpu.ops.collectives import (
+    expert_combine,
+    expert_dispatch,
+    ring_schedule,
+)
 from pytorchdistributed_tpu.ops.quant import (
     _int8_dot_value,
     absmax_scale,
@@ -356,6 +360,247 @@ def ring_row_matmul(x, w, *, mesh, axis_name: str = Axis.TENSOR,
                  if preferred_element_type is None
                  else np.dtype(preferred_element_type))
     return fn(x, w).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel MoE: explicit all_to_all dispatch/combine (ISSUE 14)
+# ---------------------------------------------------------------------------
+#
+# The GShard exchange, decomposed the same way the rings decompose the TP
+# collectives. The routing front-end (models/moe.py) assigns tokens to
+# per-GROUP capacity slots — G groups, one per (data × fsdp × expert)
+# mesh shard — so the slot tensor [G, e, c, d] enters the manual region
+# group-sharded and the dispatch is a PURE PERMUTATION of equal tiles:
+# `lax.all_to_all(split_axis=experts, concat_axis=groups)` hands every
+# member of the expert axis ALL groups' slots for ITS experts, the local
+# expert FFN runs on [G_l·ep, e/ep, c, d], and the combine a2a (the exact
+# transpose) carries the outputs home. With global capacity this would be
+# a reduce-scatter, not an a2a — per-group capacity is what makes the
+# exchange explicit and therefore schedulable.
+#
+# The custom_vjp lives INSIDE the shard_map (the same flax-scan-tracer
+# constraint as the rings); the weight cotangents' sum over the data/fsdp
+# axes — absent from wi/wo's in_specs — is inserted by shard_map's own
+# transpose. The backward reuses the two exchange directions (the
+# cotangent rides the dispatch direction out, the input cotangent rides
+# the combine direction home) and recomputes the FFN internals from the
+# saved post-dispatch residual, so backward costs exactly one more
+# dispatch/combine pair: 2 a2a forward, 2 backward per MoE layer.
+
+
+class _ExpertSpec(NamedTuple):
+    """Static expert-exchange configuration, threaded through custom_vjp
+    as a nondiff arg."""
+
+    axis_name: str              # the expert mesh axis
+    quant: str | None           # None | "int8_fwd" | "int8"
+    chunks: int                 # capacity-dim software-pipeline depth
+    gelu_approx: bool           # the FFN activation's approximate flag
+
+
+def _q8(x, cdims, *, sr=False):
+    """(int8 codes, fp32 row scales) over ``cdims`` — the a2a payload
+    format, matching the gather ring's pre-quantized hops."""
+    s = absmax_scale(x, cdims)
+    return (stochastic_quantize if sr else quantize)(x, s), s
+
+
+def _dq8(blk):
+    q, s = blk
+    return q.astype(jnp.float32) * s
+
+
+def _expert_act(spec: _ExpertSpec):
+    return functools.partial(jax.nn.gelu, approximate=spec.gelu_approx)
+
+
+def _expert_ffn_shard(recv, wi, wo, spec: _ExpertSpec):
+    """The local expert FFN on post-dispatch slots: ``recv``
+    [G2, e_l, c, d] (or the shipped (int8, scales) payload under quant),
+    ``wi`` [e_l, d, f] / ``wo`` [e_l, f, d] this member's expert shard.
+    The quantized contractions are hand-rolled int8 einsums + fp32
+    rescale by the scale outer product: quant's `_int8_dot_value` refuses
+    batch dimensions and the expert dim IS one here. The payload's row
+    scales are the ones that rode the a2a — identical to monolithic
+    quantization, since the contraction dim d is never split by the
+    exchange."""
+    act = _expert_act(spec)
+    if spec.quant:
+        qr, sr = recv if isinstance(recv, tuple) else _q8(recv, (3,))
+        qwi, swi = _q8(wi, (1,))
+        z = jnp.einsum("gecd,edf->gecf", qr, qwi,
+                       preferred_element_type=jnp.int32)
+        z = (z.astype(jnp.float32) * sr
+             * swi.reshape(1, wi.shape[0], 1, wi.shape[2]))
+        h = act(z)
+        qh, sh = _q8(h, (3,))
+        qwo, swo = _q8(wo, (1,))
+        y = jnp.einsum("gecf,efd->gecd", qh, qwo,
+                       preferred_element_type=jnp.int32)
+        return (y.astype(jnp.float32) * sh
+                * swo.reshape(1, wo.shape[0], 1, wo.shape[2]))
+    z = jnp.einsum("gecd,edf->gecf", recv, wi,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("gecf,efd->gecd", act(z), wo,
+                      preferred_element_type=jnp.float32)
+
+
+def _expert_pipeline_shard(slots, wi, wo, spec: _ExpertSpec, *,
+                           sr_payload=False):
+    """Dispatch → expert FFN → combine, with the capacity dim chunked
+    into ``spec.chunks`` software-pipeline stages: chunk i+1's dispatch
+    a2a is issued BEFORE chunk i's FFN, and chunk i's combine a2a has no
+    consumer until the final concatenate — so the scheduler can hide both
+    exchanges behind the neighbouring chunk's expert matmuls (the rings'
+    latency-hiding recipe, with a2a hops instead of ppermute). A chunk
+    count that doesn't divide capacity silently degrades to monolithic —
+    the knob can never turn a valid program into a shape error.
+
+    Returns ``(out_slots [g_l, e, c, d] fp32, recv [g_l·ep, e/ep, c, d])``
+    — the dequantized post-dispatch residual the backward recomputes the
+    FFN from, saving a third a2a pair."""
+    axis = spec.axis_name
+    c = slots.shape[2]
+    k = spec.chunks if spec.chunks > 1 and c % spec.chunks == 0 else 1
+    cc = c // k
+
+    def shipped(i):
+        blk = lax.dynamic_slice_in_dim(slots, i * cc, cc, axis=2)
+        if spec.quant:
+            blk = _q8(blk, (3,), sr=sr_payload)
+        return expert_dispatch(blk, axis)
+
+    recv = shipped(0)
+    outs, recvs = [], []
+    for i in range(k):
+        nxt = shipped(i + 1) if i + 1 < k else None  # prefetched hop
+        recvs.append(_dq8(recv) if spec.quant else recv)
+        y = _expert_ffn_shard(recv, wi, wo, spec)
+        outs.append(expert_combine(y, axis))
+        recv = nxt
+    out = outs[0] if k == 1 else jnp.concatenate(outs, axis=2)
+    res = recvs[0] if k == 1 else jnp.concatenate(recvs, axis=2)
+    return out, res
+
+
+def _expert_fwd_parts(x, dispatch, gates, wi, wo, spec: _ExpertSpec):
+    """Local slot-build → exchange pipeline → weighted combine. ``x``
+    [G_l, n, d]; ``dispatch``/``gates`` [G_l, n, e, c] one-hot slot
+    assignments / gate-weighted assignments from the router."""
+    slots = jnp.einsum("gnec,gnd->gecd", dispatch, x,
+                       preferred_element_type=jnp.float32)
+    out_slots, recv = _expert_pipeline_shard(slots, wi, wo, spec)
+    out = jnp.einsum("gnec,gecd->gnd", gates, out_slots,
+                     preferred_element_type=jnp.float32)
+    return out, out_slots, recv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _expert_core(x, dispatch, gates, wi, wo, spec: _ExpertSpec):
+    return _expert_fwd_parts(x, dispatch, gates, wi, wo, spec)[0]
+
+
+def _expert_core_fwd(x, dispatch, gates, wi, wo, spec: _ExpertSpec):
+    out, out_slots, recv = _expert_fwd_parts(x, dispatch, gates, wi, wo,
+                                             spec)
+    return out, (x, dispatch, gates, wi, wo, recv, out_slots)
+
+
+def _expert_core_bwd(spec: _ExpertSpec, res, g):
+    x, dispatch, gates, wi, wo, recv, out_slots = res
+    axis = spec.axis_name
+    # full "int8" mode stochastic-rounds the traveling cotangent payloads
+    # (the rings' gradient-hop semantics); the expert-side matmuls then
+    # run fp32 on the saved/dequantized operands — the saved-operand
+    # contract of the monolithic quantized dot. "int8_fwd" ships fp32.
+    sr = spec.quant == "int8"
+    g = g.astype(jnp.float32)
+    dgates = jnp.einsum("gnd,gecd->gnec", g, out_slots)
+    dout = jnp.einsum("gnec,gnd->gecd", gates.astype(jnp.float32), g)
+    # the cotangent travels TO the experts over the dispatch-direction
+    # a2a (the forward combine's transpose) ...
+    if sr:
+        dy = _dq8(expert_dispatch(_q8(dout, (3,), sr=True), axis))
+    else:
+        dy = expert_dispatch(dout, axis)
+    wi32, wo32 = wi.astype(jnp.float32), wo.astype(jnp.float32)
+    z = jnp.einsum("gecd,edf->gecf", recv, wi32,
+                   preferred_element_type=jnp.float32)
+    h, act_vjp = jax.vjp(_expert_act(spec), z)
+    dwo = jnp.einsum("gecf,gecd->efd", h, dy)
+    dh = jnp.einsum("gecd,efd->gecf", dy, wo32)
+    (dz,) = act_vjp(dh)
+    dwi = jnp.einsum("gecd,gecf->edf", recv, dz)
+    drecv = jnp.einsum("gecf,edf->gecd", dz, wi32)
+    # ... and home again over the combine direction (dispatch's
+    # transpose). dwi/dwo's sum over the data/fsdp axes happens in
+    # shard_map's transpose at the region boundary.
+    if sr:
+        dslots = _dq8(expert_combine(_q8(drecv, (3,), sr=True), axis))
+    else:
+        dslots = expert_combine(drecv, axis)
+    dx = jnp.einsum("gnec,gecd->gnd", dispatch.astype(jnp.float32), dslots)
+    ddispatch = jnp.einsum("gnd,gecd->gnec", x.astype(jnp.float32), dslots)
+    return (dx.astype(x.dtype), ddispatch.astype(dispatch.dtype),
+            dgates.astype(gates.dtype), dwi.astype(wi.dtype),
+            dwo.astype(wo.dtype))
+
+
+_expert_core.defvjp(_expert_core_fwd, _expert_core_bwd)
+
+
+def expert_a2a_ffn(x, dispatch, gates, wi, wo, *, mesh,
+                   axis_name: str = Axis.EXPERT, quant: str | None = None,
+                   chunks: int = 1, gelu_approx: bool = True,
+                   preferred_element_type=None):
+    """Expert-parallel MoE FFN with explicit all_to_all dispatch/combine
+    under shard_map.
+
+    ``x`` [G, n, d] grouped tokens, ``dispatch``/``gates`` [G, n, e, c]
+    the router's slot assignments, ``wi`` [e, d, f] / ``wo`` [e, f, d]
+    the stacked expert kernels (expert dim sharded over ``axis_name``).
+    G must tile data × fsdp × expert (``expert_a2a_applicable`` is the
+    static gate callers check before routing here). With ``quant``, the
+    dispatch payload ships as pre-quantized int8 codes + fp32 row scales
+    and the expert matmuls consume them directly — exchange traffic ÷4
+    vs fp32 on top of the overlap. ``chunks`` > 1 pipelines the exchange
+    behind the expert matmuls chunk by chunk."""
+    spec = _ExpertSpec(axis_name,
+                       None if quant in (None, "none") else quant,
+                       max(1, int(chunks)), bool(gelu_approx))
+    grp = (Axis.DATA, Axis.FSDP, axis_name)
+    fn = jax.shard_map(
+        functools.partial(_expert_core, spec=spec),
+        mesh=mesh,
+        in_specs=(P(grp, None, None),
+                  P(grp, None, None, None),
+                  P(grp, None, None, None),
+                  P(axis_name, None, None),
+                  P(axis_name, None, None)),
+        out_specs=P(grp, None, None),
+        check_vma=False,
+    )
+    out_dtype = (x.dtype if preferred_element_type is None
+                 else np.dtype(preferred_element_type))
+    return fn(x, dispatch, gates, wi, wo).astype(out_dtype)
+
+
+def expert_a2a_applicable(num_groups: int, num_experts: int, mesh,
+                          axis_name: str = Axis.EXPERT) -> bool:
+    """Static check that the explicit exchange tiles these shapes on this
+    mesh: an expert axis of size > 1 that divides the expert count, and a
+    group count that tiles data × fsdp × expert (each shard owns whole
+    groups). Callers fall back to the dense einsum path when False, so
+    the dispatch knob can never turn a valid program into a shape
+    error."""
+    if mesh is None or axis_name not in getattr(mesh, "shape", {}):
+        return False
+    ep = mesh.shape[axis_name]
+    if ep <= 1 or num_experts % ep:
+        return False
+    shards = (mesh.shape.get(Axis.DATA, 1) * mesh.shape.get(Axis.FSDP, 1)
+              * ep)
+    return num_groups >= shards and num_groups % shards == 0
 
 
 def ring_divisibility(x_shape, w_shape, mesh, axis_name: str,

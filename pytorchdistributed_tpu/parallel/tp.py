@@ -40,6 +40,8 @@ class Logical:
     KV = "kv"            # per-head dim (never sharded)
     VOCAB = "vocab"      # embedding/logit dim
     EXPERT = "expert"    # MoE expert dim
+    EGROUP = "egroup"    # MoE routing-group dim (models/moe.py grouped
+    #                      tokens: one group per data×fsdp×expert shard)
     CONV_IN = "conv_in"
     CONV_OUT = "conv_out"
     STAGE = "stage"      # pipeline stage dim (scanned-layer models)
@@ -51,16 +53,27 @@ _COMMON_ACTIVATION_RULES = (
     (Logical.BATCH, (Axis.DATA, Axis.FSDP)),
     (Logical.SEQ, Axis.SEQ),
     (Logical.STAGE, Axis.PIPE),
+    # MoE routing groups tile every batch-ish axis INCLUDING "expert":
+    # the layout in which grouped dispatch is a pure permutation (a
+    # literal all_to_all), and a free slice of the (data, fsdp)-sharded
+    # tokens since they were replicated over the expert axis.
+    (Logical.EGROUP, (Axis.DATA, Axis.FSDP, Axis.EXPERT)),
 )
 
 _PARAM_RULES = {
-    # DDP: params fully replicated.
-    "dp": (),
+    # DDP: params fully replicated — except stacked expert kernels,
+    # which shard over "expert" under EVERY strategy (a dp×expert mesh
+    # is the canonical MoE training mesh; on an expert-less mesh the
+    # rule is a no-op).
+    "dp": (
+        (Logical.EXPERT, Axis.EXPERT),
+    ),
     # ZeRO-3: shard the embed dim of every large param over "fsdp".
     "fsdp": (
         (Logical.EMBED, Axis.FSDP),
         (Logical.VOCAB, Axis.FSDP),
         (Logical.CONV_OUT, Axis.FSDP),
+        (Logical.EXPERT, Axis.EXPERT),
     ),
     # Megatron TP: FFN columns, attention heads and vocab over "tensor".
     "tp": (

@@ -196,6 +196,16 @@ class StepAccounting:
             return None
         return round(self.comm_bytes_per_step / sec_per_step, 1)
 
+    @property
+    def a2a_bytes_per_step(self) -> int:
+        """Per-device all-to-all bytes (plain + ragged) — the
+        expert-parallel MoE dispatch/combine volume (ISSUE 14), already
+        inside ``comm_bytes_per_step`` but surfaced on its own because
+        it's the term the capacity factor, int8 payloads and chunked
+        overlap all act on (bench --mode moe stamps it per A/B leg)."""
+        return int(sum(self.comm_bytes_by_op.get(k, 0)
+                       for k in ("all-to-all", "ragged-all-to-all")))
+
     def comm_stall_frac(self, sec_per_step: float | None = None,
                         ) -> float | None:
         """Estimated fraction of the step stalled on collectives — the

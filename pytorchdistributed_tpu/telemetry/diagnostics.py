@@ -191,6 +191,14 @@ def collect_activation_tables(coll: Mapping[str, Any]) -> dict[str, Any]:
     if "int8_sat" in by_name:
         sat = stacked(by_name["int8_sat"])
         out["int8_sat"] = sat.reshape(-1)
+    if "moe_overflow" in by_name:
+        ovf = stacked(by_name["moe_overflow"])
+        out["moe_overflow"] = ovf.reshape(-1)
+    if "moe_frac" in by_name:
+        # per-expert first-choice routing fractions: [L, e] — the one 2-D
+        # table (the JSONL writer ravels rows, so e columns per layer)
+        frac = stacked(by_name["moe_frac"])
+        out["moe_frac"] = frac.reshape(-1, frac.shape[-1])
     return out
 
 
@@ -303,6 +311,15 @@ def diagnostics_metrics(*, acts, grads, params, updates,
                 tables["act_nonfinite"])
         if "int8_sat" in tables:
             out[SCALAR_PREFIX + "int8_sat"] = tables["int8_sat"].mean()
+        if "moe_overflow" in tables:
+            # mean over MoE layers: the headline "how much routed traffic
+            # rode the residual" number the capacity factor is tuned by
+            out[SCALAR_PREFIX + "moe_overflow"] = (
+                tables["moe_overflow"].mean())
+        if "moe_frac" in tables:
+            # worst per-expert routing share (uniform = 1/e; → 1.0 as the
+            # router collapses onto one expert)
+            out[SCALAR_PREFIX + "moe_frac_max"] = tables["moe_frac"].max()
     return out
 
 

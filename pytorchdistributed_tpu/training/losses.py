@@ -178,18 +178,60 @@ def fused_token_cross_entropy_loss(model, params, batch, rng=None, *,
                   **_diag_extras(mods, diagnostics)}
 
 
-MOE_AUX_WEIGHT = 0.01  # Switch Transformer's load-balance coefficient
+MOE_AUX_WEIGHT = 0.01    # Switch Transformer's load-balance coefficient
+MOE_ZLOSS_WEIGHT = 1e-3  # ST-MoE router z-loss coefficient
+
+
+def _moe_sown_terms(losses_col):
+    """Split one apply's sown "losses" collection into its two MoE terms
+    by leaf NAME — ``moe_zloss`` leaves vs everything else (the
+    load-balance aux) — each mean-reduced over layers. models/moe.py sows
+    both under the same collection; summing them blindly would let the
+    z-loss ride the aux weight."""
+    import jax
+
+    aux, z = [], []
+
+    def walk(node):
+        for key, v in node.items():
+            if hasattr(v, "items"):
+                walk(v)
+            elif key == "moe_zloss":
+                z.extend(jax.tree.leaves(v))
+            else:
+                aux.extend(jax.tree.leaves(v))
+
+    walk(losses_col)
+
+    def mean_of(leaves):
+        if not leaves:
+            return jnp.float32(0.0)
+        return sum(jnp.mean(v) for v in leaves) / len(leaves)
+
+    return mean_of(aux), mean_of(z)
+
+
+def pipeline_aux_fold(losses_col):
+    """One block's sown MoE losses folded into the SINGLE scalar the
+    pipeline stage schedule accumulates (parallel/pipeline.py carries one
+    aux carry, later multiplied by MOE_AUX_WEIGHT): aux +
+    (MOE_ZLOSS_WEIGHT/MOE_AUX_WEIGHT)·zloss, so each term still lands at
+    its own effective weight. Sum (not mean) over this block's leaves —
+    the schedule divides by num_layers at the end."""
+    # _moe_sown_terms mean-reduces; one block sows one leaf per term, so
+    # the mean IS the per-block sum here.
+    aux, z = _moe_sown_terms(losses_col)
+    return aux + (MOE_ZLOSS_WEIGHT / MOE_AUX_WEIGHT) * z
 
 
 def moe_token_cross_entropy_loss(model, params, batch, rng=None, *,
                                  diagnostics=False):
     """`token_cross_entropy_loss` (same {tokens, targets, loss_mask?}
-    contract) + the Switch load-balance auxiliary loss sown by models/moe.py
-    (collection "losses"). The aux term (mean over layers, weight
-    `MOE_AUX_WEIGHT`) pushes the router toward uniform expert utilization;
-    without it top-1 routing collapses onto one expert."""
-    import jax
-
+    contract) + the MoE auxiliary terms sown by models/moe.py (collection
+    "losses"): the Switch load-balance loss (mean over layers, weight
+    `MOE_AUX_WEIGHT` — without it top-1 routing collapses onto one
+    expert) and the ST-MoE router z-loss (weight `MOE_ZLOSS_WEIGHT`,
+    keeps router logits bounded), separated by sown name."""
     logits, mods = _apply_collecting(
         model, params, batch["tokens"], diagnostics=diagnostics,
         mutable=["losses"],
@@ -197,8 +239,8 @@ def moe_token_cross_entropy_loss(model, params, batch, rng=None, *,
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"])
     ce, extras = _token_loss_reduce(ce, batch)
-    sown = jax.tree.leaves(mods.get("losses", {}))
-    aux = (sum(jnp.mean(v) for v in sown) / max(len(sown), 1)) if sown else 0.0
-    loss = ce + MOE_AUX_WEIGHT * aux
+    aux, zloss = _moe_sown_terms(mods.get("losses", {}))
+    loss = ce + MOE_AUX_WEIGHT * aux + MOE_ZLOSS_WEIGHT * zloss
     return loss, {"loss": loss, "ce": ce, "moe_aux": jnp.float32(aux),
+                  "moe_zloss": jnp.float32(zloss),
                   **extras, **_diag_extras(mods, diagnostics)}

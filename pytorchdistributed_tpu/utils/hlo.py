@@ -228,6 +228,24 @@ def overlap_census(hlo_text: str) -> dict:
     }
 
 
+def a2a_census(hlo_text: str) -> dict[str, int]:
+    """The expert-parallel dispatch/combine signature (ISSUE 14): total
+    ``all-to-all`` occurrences (plain + ragged, async starts counted
+    once) and their per-device result bytes. The MoE a2a path
+    (ops/overlap.expert_a2a_ffn) emits exactly 2 per MoE layer forward
+    (dispatch + combine) and 2 more in backward — ×chunks when capacity
+    pipelining splits them — so the committed ``count`` pins both that
+    the explicit exchange actually lowered to all_to_all (not the
+    partitioner's allgather+dynamic-slice fallback) and that no pass
+    duplicated it; ``bytes`` pins the payload (int8 dispatch payloads
+    shrink it ~4x minus the fp32 scale sidecar)."""
+    counts = collective_counts(hlo_text)
+    nbytes = collective_bytes(hlo_text)
+    kinds = ("all-to-all", "ragged-all-to-all")
+    return {"count": sum(counts[k] for k in kinds),
+            "bytes": sum(nbytes[k] for k in kinds)}
+
+
 def int8_counts(hlo_text: str) -> dict[str, int]:
     """Census of the int8 quantized-matmul op mix (ops/quant.py):
     ``s8_values`` — instructions producing an s8 tensor (the per-operand
@@ -280,6 +298,8 @@ def compiled_invariants(compiled) -> dict:
     * ``overlap`` — `overlap_census`: async start/done pairing, ops
       scheduled inside collective windows, and the ppermute ring count
       (the chunked collective-matmul signature — ISSUE 5).
+    * ``a2a`` — `a2a_census`: all-to-all count + bytes, the
+      expert-parallel MoE dispatch/combine signature (ISSUE 14).
     """
     mem = compiled.memory_analysis()
     cost = compiled.cost_analysis() or {}
@@ -295,4 +315,5 @@ def compiled_invariants(compiled) -> dict:
         "int8_ops": int8_counts(text),
         "comm_bytes": collective_bytes(text),
         "overlap": overlap_census(text),
+        "a2a": a2a_census(text),
     }

@@ -403,21 +403,25 @@ COMMITTED: dict[str, dict] = {
         "int8_ops": {"s8_values": 0, "int_dots": 0},
         "comm_bytes": {'all-reduce': 720392, 'all-gather': 196608, 'reduce-scatter': 0, 'collective-permute': 16384, 'all-to-all': 524288, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
     },
-    # NOTE the zero all-to-all: at these shapes XLA partitions the
-    # one-hot dispatch einsums into all-gather + all-reduce rather than a
-    # literal all-to-all — the census records what the compiler actually
-    # emits, which is exactly why it's worth pinning.
+    # ISSUE 14 recapture: the explicit a2a dispatch (ops/overlap.
+    # expert_a2a_ffn) replaced the auto-partitioned one-hot einsums,
+    # which XLA used to lower as all-gather + all-reduce with a GLOBAL
+    # capacity buffer. Grouped per-shard capacity cut per-device flops
+    # 852M -> 198M and temp bytes 45.7M -> 8.4M, and the 4 all-to-alls
+    # in the scanned layer body are exactly the contract: dispatch +
+    # combine forward, and both exchange directions again in backward.
     "moe_ep4": {
-        "flops": 852428288.0,
-        "temp_bytes": 45698232,
+        "flops": 197734688.0,
+        "temp_bytes": 8367224,
         "arg_bytes": 1399816,
         "alias_bytes": 1391624,
-        "collectives": {"all-reduce": 30, "all-gather": 3,
-                        "reduce-scatter": 0, "collective-permute": 0,
-                        "all-to-all": 0, "ragged-all-to-all": 0,
-                        "collective-broadcast": 0},
-        "int8_ops": {"s8_values": 0, "int_dots": 0},
-        "comm_bytes": {'all-reduce': 1293072, 'all-gather': 40960, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 0, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
+        "collectives": {'all-reduce': 23, 'all-gather': 3,
+                        'reduce-scatter': 0, 'collective-permute': 0,
+                        'all-to-all': 4, 'ragged-all-to-all': 0,
+                        'collective-broadcast': 0},
+        "int8_ops": {'s8_values': 0, 'int_dots': 0},
+        "comm_bytes": {'all-reduce': 675624, 'all-gather': 34816, 'reduce-scatter': 0, 'collective-permute': 0, 'all-to-all': 327680, 'ragged-all-to-all': 0, 'collective-broadcast': 0},
+        "a2a": {'count': 4, 'bytes': 327680},
     },
     "gpt2s_2l": {
         "flops": 348754477056.0,
@@ -556,6 +560,15 @@ def _assert_invariants(name, inv, want):
             f"comm-volume half of the census, and a StepAccounting input: "
             f"either communication volume really moved (deliberate?) or "
             f"the telemetry comm-bytes/MFU math would now misreport")
+    if "a2a" in want:
+        assert inv["a2a"] == want["a2a"], (
+            f"{name}: all-to-all census changed: got {inv['a2a']}, "
+            f"committed {want['a2a']} — the expert-parallel MoE "
+            f"dispatch/combine signature (2 fwd + 2 bwd per MoE layer "
+            f"from ops/overlap.expert_a2a_ffn): the explicit exchange "
+            f"either stopped lowering to a literal all_to_all or a pass "
+            f"duplicated/split one, and the payload bytes pin the int8 "
+            f"vs fp32 wire format")
     lo = want["temp_bytes"] * (1 - TEMP_BYTES_RTOL)
     hi = want["temp_bytes"] * (1 + TEMP_BYTES_RTOL)
     assert lo <= inv["temp_bytes"] <= hi, (
