@@ -1218,6 +1218,171 @@ def bench_router() -> dict:
     return result
 
 
+def bench_autoscale() -> dict:
+    """Autoscaled-vs-static A/B (ISSUE 15): the SAME seeded flash-crowd
+    trace over a hot(10x)/calm tenant mix, served two ways at identical
+    engine geometry and queue bound:
+
+      * ``static``     — the fleet pinned at 1 replica (the pre-ISSUE-15
+        shape: whatever the crowd oversubscribes, the queue cap sheds);
+      * ``autoscaled`` — the same 1-replica baseline plus the SLO
+        control loop: sustained queue-depth breaches warm-join replicas
+        into the crowd (in-process joins share the jit cache — the leg
+        stamps ``recompiles`` = fresh XLA traces after warmup, must be
+        0), and the drain-down after the crowd removes them gracefully.
+
+    Both legs replay on the traffic harness's FakeClock (zero wall-clock
+    sleeps: replay speed is whatever the engines can step), so arrivals
+    are byte-identical across legs and runs. Stamps per leg: SLO
+    attainment (completed / submitted — a shed request IS the SLO miss
+    under a bounded queue), per-tenant shed split (calm must stamp 0 in
+    both legs: weighted shedding never touches a compliant tenant),
+    mean/peak healthy replicas over the replay (``replicas_per_qps`` =
+    mean replicas / offered QPS — the capacity-efficiency stamp), and
+    for the autoscaled leg the scale-up/-down counts plus the
+    decision -> first-token ``reaction_s``. The headline metric is the
+    autoscaled leg's attainment; ``attainment_delta`` (autoscaled -
+    static) must stamp >= 0.
+
+    Knobs: PTD_AUTO_{QPS,PEAK,DURATION,SLOTS,QUEUE,MAX_REPLICAS};
+    PTD_QUANT rides the model config like every serving bench.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import (
+        Autoscaler,
+        FakeClock,
+        ReplicaRouter,
+        SLOConfig,
+        TenantConfig,
+        TenantTraffic,
+        make_trace,
+        replay,
+    )
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    base_qps = float(os.environ.get("PTD_AUTO_QPS", "5.0"))
+    peak_mult = float(os.environ.get("PTD_AUTO_PEAK", "30.0"))
+    duration_s = float(os.environ.get("PTD_AUTO_DURATION", "4.0"))
+    num_slots = int(os.environ.get("PTD_AUTO_SLOTS", "4"))
+    max_queue = int(os.environ.get("PTD_AUTO_QUEUE", "8"))
+    max_replicas = int(os.environ.get("PTD_AUTO_MAX_REPLICAS", "3"))
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    trace = make_trace(
+        seed=11, duration_s=duration_s, base_qps=base_qps, shape="flash",
+        peak_mult=peak_mult, flash_at_s=duration_s / 4.0,
+        flash_len_s=duration_s * 0.375,
+        tenants=(TenantTraffic("hot", share=10.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=cfg.vocab_size, prompt_cap=24, new_cap=8)
+    qps_offered = len(trace) / duration_s
+
+    def build(replicas):
+        r = ReplicaRouter(
+            model, params, replicas=replicas,
+            engine_kwargs=dict(num_slots=num_slots, prefill_bucket=32),
+            warmup_lens=(32,), max_queue=max_queue, faults=None,
+            tenants={"hot": TenantConfig(weight=1.0),
+                     "calm": TenantConfig(weight=1.0)})
+        r.warmup()
+        return r
+
+    def run(router, autoscaler=None, clock=None):
+        fleet = []  # healthy-count samples, one per replay tick
+
+        def sample(ticks, clk):
+            fleet.append(router.pool_state()["fleet"]["healthy"])
+
+        replay(router, trace, clock=clock or FakeClock(), tick_s=0.02,
+               autoscaler=autoscaler, on_tick=sample)
+        s = router.summary()
+        tens = s["tenants"]
+        p99s = [t["ttft_ms_p99"] for t in tens.values()
+                if t.get("ttft_ms_p99") is not None]
+        return {
+            "slo_attainment": (round(s["completed"] / s["submitted"], 4)
+                               if s["submitted"] else None),
+            "submitted": s["submitted"], "completed": s["completed"],
+            "shed_requests": s["shed_requests"],
+            "shed_by_tenant": {n: t["shed"] for n, t in tens.items()},
+            "ttft_ms_p99_by_tenant": {
+                n: t.get("ttft_ms_p99") for n, t in tens.items()},
+            "tenant_p99_spread_ms": (round(max(p99s) - min(p99s), 3)
+                                     if len(p99s) > 1 else None),
+            "replicas_mean": round(float(np.mean(fleet)), 3),
+            "replicas_peak": int(max(fleet)),
+            "replicas_per_qps": round(
+                float(np.mean(fleet)) / qps_offered, 4),
+        }
+
+    # -- leg 1: static at baseline --------------------------------------
+    router = build(1)
+    static = run(router)
+    router.close()
+
+    # -- leg 2: autoscaled from the same baseline -----------------------
+    router = build(1)
+    clk = FakeClock()
+    # TTFT is wall-clock (not fake-clock) — neutralized so CPU step
+    # timing isn't a control input; queue depth is the breach signal
+    asc = Autoscaler(router,
+                     SLOConfig(queue_high=3.0, occupancy_high=0.9,
+                               occupancy_low=0.5, shed_rate_max=1.0,
+                               ttft_target_ms=1e9),
+                     min_replicas=1, max_replicas=max_replicas,
+                     breach_ticks=2, clear_ticks=25, up_cooldown_s=0.3,
+                     down_cooldown_s=0.2, clock=clk)
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    auto = run(router, autoscaler=asc, clock=clk)
+    # keep ticking the idle fleet past the crowd: the graceful
+    # drain-down back to baseline is part of the measurement
+    for _ in range(3000):
+        router.step()
+        asc.step()
+        clk.advance(0.02)
+        if (router.pool_state()["fleet"]["healthy"] == 1
+                and router.pool_state()["fleet"]["draining"] == 0):
+            break
+    recompiles = (sum(serving_engine.TRACE_COUNTS.values())
+                  - sum(traces0.values()))
+    asum = asc.summary()
+    auto.update(scale_ups=asum["scale_ups"],
+                scale_downs=asum["scale_downs"],
+                drained_to_baseline=(
+                    router.pool_state()["fleet"]["healthy"] == 1),
+                reaction_s_mean=asum["reaction_s_mean"],
+                reaction_s_max=asum["reaction_s_max"],
+                recompiles=recompiles)
+    router.close()
+
+    result = {
+        "metric": "autoscale_slo_attainment",
+        "value": auto["slo_attainment"], "unit": "frac",
+        "attainment_delta": round(auto["slo_attainment"]
+                                  - static["slo_attainment"], 4),
+        "trace": {"seed": 11, "shape": "flash", "requests": len(trace),
+                  "base_qps": base_qps, "peak_mult": peak_mult,
+                  "duration_s": duration_s,
+                  "qps_offered": round(qps_offered, 2)},
+        "num_slots": num_slots, "max_queue": max_queue,
+        "max_replicas": max_replicas,
+        "autoscaled": auto, "static": static,
+    }
+    _stamp_overrides(result, ("PTD_AUTO_QPS", "PTD_AUTO_PEAK",
+                              "PTD_AUTO_DURATION", "PTD_AUTO_SLOTS",
+                              "PTD_AUTO_QUEUE", "PTD_AUTO_MAX_REPLICAS",
+                              "PTD_QUANT"))
+    return result
+
+
 def bench_disagg() -> dict:
     """Disaggregated serving A/B (ISSUE 12): the SAME bursty
     shared-prefix trace (one hot system prompt + unique tails, arriving
@@ -1947,7 +2112,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "bert": bench_bert, "vit": bench_vit,
            "resnet50": bench_resnet50, "generate": bench_generate,
            "serve": bench_serve, "kvcompress": bench_kvcompress,
-           "router": bench_router,
+           "router": bench_router, "autoscale": bench_autoscale,
            "disagg": bench_disagg, "coldstart": bench_coldstart,
            "moe": bench_moe,
            "mlp": bench_mlp, "sweep": bench_sweep,

@@ -245,6 +245,33 @@ _QUICK = (
     "test_disagg.py::test_fleet_prefix_ships_int8_blocks",
     "test_disagg.py::test_zero_recompiles_steady_state_disagg",
     "test_disagg.py::test_report_cli_renders_disagg_columns",
+    # SLO-aware autoscaling + multi-tenant admission (ISSUE 15): the
+    # traffic-generator determinism/shape units, the WDRR fairness and
+    # per-tenant cap/rate properties (hot tenant at 10x cannot shed a
+    # compliant one), the fake-clock autoscaler hysteresis/cooldown/
+    # bounds/role-aware units against a stub router, the signal-ring
+    # stats, the tombstoned add/remove lifecycle, the closed-loop
+    # flash-crowd -> warm scale-up -> drain-down demo (zero fresh XLA
+    # traces across joins), lossless tenant preemption, and the
+    # per-request KV window override walls + bitwise anchor — all
+    # in-process. The SUBPROCESS autoscale e2e stays full-tier-only.
+    "test_autoscale.py::test_traffic_determinism_and_validation",
+    "test_autoscale.py::test_traffic_shapes_tenant_mix_and_prefixes",
+    "test_autoscale.py::test_wdrr_weighted_token_fairness_and_priority_tiers",
+    "test_autoscale.py::test_admission_per_tenant_caps_and_rate_bucket",
+    "test_autoscale.py::test_hot_tenant_at_10x_cannot_shed_compliant_tenant",
+    "test_autoscale.py::test_pressure_clamps_kv_windows_by_priority",
+    "test_autoscale.py::test_admission_deque_protocol_roundtrip",
+    "test_autoscale.py::test_autoscaler_hysteresis_cooldown_and_bounds",
+    "test_autoscale.py::test_autoscaler_role_aware_disagg_pools",
+    "test_autoscale.py::test_signal_ring_bounded_stats_and_snapshot",
+    "test_autoscale.py::test_router_add_remove_replica_tombstone_history",
+    "test_autoscale.py::test_flash_crowd_autoscales_warm_and_drains_back",
+    "test_autoscale.py::test_router_preempts_over_budget_tenant_losslessly",
+    "test_autoscale.py::test_router_rejects_incompatible_kv_override_loudly",
+    "test_autoscale.py::test_per_request_window_override_bitwise",
+    "test_autoscale.py::test_kv_override_rejection_walls",
+    "test_autoscale.py::test_engine_preempt_request_lossless_and_states",
 )
 
 

@@ -84,6 +84,20 @@ def main():
                              "routes through the explicit all_to_all "
                              "dispatch, and the engine ticks on the "
                              "same dp x expert mesh")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="serve a seeded flash-crowd trace through "
+                             "the SLO autoscaler (README 'Autoscaling & "
+                             "multi-tenancy'): the fleet starts at "
+                             "--replicas, warm-joins replicas into the "
+                             "crowd with zero fresh compiles, and "
+                             "drains back to baseline after it passes")
+    parser.add_argument("--tenants", type=int, default=0,
+                        help="> 0: multi-tenant admission — requests "
+                             "carry round-robin tenant tags (t0 gets a "
+                             "10x share under --autoscale), the WDRR "
+                             "scheduler keeps the token split weighted-"
+                             "fair, and the summary prints the per-"
+                             "tenant table")
     parser.add_argument("--chaos", action="store_true",
                         help="with --replicas > 1: crash replica 0 "
                              "mid-trace — watch the router redispatch "
@@ -155,11 +169,13 @@ def main():
                                               args.draft_layers)
         spec_kw = dict(draft_config=draft.cfg, draft_params=draft_params)
 
-    if args.replicas > 1:
+    if args.replicas > 1 or args.autoscale or args.tenants:
         # REPLICATED serving (ISSUE 9): the router owns N engines,
         # balances on their health snapshots and — with --chaos — shows
         # lossless mid-stream failover: the crashed replica's streams
-        # resume on a survivor with identical tokens
+        # resume on a survivor with identical tokens. --autoscale /
+        # --tenants (ISSUE 15) ride the same router path, so a
+        # 1-replica fleet works too.
         from pytorchdistributed_tpu.serving import ReplicaRouter
 
         # no --chaos: leave the router's default ("auto") so the
@@ -179,6 +195,15 @@ def main():
                     f"replica=0")
             print(f"--- chaos armed: {spec} ---")
             router_kw["faults"] = FaultInjector(FaultPlan.parse(spec))
+        names = ["default"]
+        if args.tenants:
+            # equal WDRR weights: fairness comes from the scheduler,
+            # not from handicapping the hot tenant's quota
+            from pytorchdistributed_tpu.serving import TenantConfig
+
+            names = [f"t{i}" for i in range(args.tenants)]
+            router_kw["tenants"] = {n: TenantConfig(weight=1.0)
+                                    for n in names}
         router = ReplicaRouter(
             model, params, replicas=args.replicas, roles=roles,
             engine_kwargs=dict(num_slots=args.num_slots,
@@ -191,22 +216,79 @@ def main():
             **router_kw)
         router.warmup()
         router.install_sigterm_drain()
-        reqs = []
-        for i in range(args.requests):
-            prompt = rng.integers(1, cfg.vocab_size,
-                                  (int(rng.integers(3, 12)),)
-                                  ).astype(np.int32)
-            sampling = (SamplingParams() if i % 2 == 0 else
-                        SamplingParams(temperature=0.7, top_k=8, seed=i))
-            reqs.append(router.submit(prompt, max_new_tokens=8,
-                                      sampling=sampling))
-            router.step()
-        router.run_until_idle()
-        for r in reqs:
-            hops = "->".join(map(str, r.replicas))
-            print(f"req {r.id} (replica {hops}, {r.finish_reason}, "
-                  f"retries {r.retries}): "
-                  f"{r.prompt.tolist()} -> {r.tokens}")
+        if args.autoscale:
+            # a seeded flash crowd on the fake-clock replay driver: the
+            # autoscaler warm-joins replicas into the breach (zero
+            # fresh compiles — in-process joins share the jit cache)
+            # and the post-crowd drain removes them gracefully
+            from pytorchdistributed_tpu.serving import (
+                Autoscaler,
+                FakeClock,
+                SLOConfig,
+                TenantTraffic,
+                make_trace,
+                replay,
+            )
+
+            mix = tuple(
+                TenantTraffic(n, share=(10.0 if i == 0 and len(names) > 1
+                                        else 1.0))
+                for i, n in enumerate(names))
+            trace = make_trace(
+                seed=0, duration_s=3.0, base_qps=4.0, shape="flash",
+                peak_mult=20.0, tenants=mix,
+                vocab_size=cfg.vocab_size, prompt_cap=12, new_cap=8)
+            clk = FakeClock()
+            # TTFT is wall-clock, not fake-clock — neutralized so host
+            # step timing isn't a control input in a demo run
+            asc = Autoscaler(
+                router,
+                SLOConfig(queue_high=3.0, shed_rate_max=1.0,
+                          ttft_target_ms=1e9),
+                min_replicas=args.replicas,
+                max_replicas=args.replicas + 2, breach_ticks=2,
+                clear_ticks=25, up_cooldown_s=0.3, down_cooldown_s=0.2,
+                clock=clk)
+            print(f"--- flash crowd: {len(trace)} requests over "
+                  f"{sorted({t.tenant for t in trace})} ---")
+            reqs = replay(router, trace, clock=clk, tick_s=0.02,
+                          autoscaler=asc)
+            for _ in range(3000):   # drain back down to baseline
+                router.step()
+                asc.step()
+                clk.advance(0.02)
+                st = router.pool_state()["fleet"]
+                if (st["healthy"] == args.replicas
+                        and st["draining"] == 0):
+                    break
+            for d in asc.decisions:
+                print(f"  {d['action']} replica={d['replica']} "
+                      f"why={','.join(d['why'])} "
+                      f"queue={d['m_queue_depth']:.1f}")
+            done = sum(1 for r in reqs if r.finish_reason
+                       in ("length", "stop"))
+            print(f"served {done}/{len(reqs)} "
+                  f"(shed {sum(1 for r in reqs if r.finish_reason == 'shed')})")
+            print("autoscaler summary:", asc.summary())
+        else:
+            reqs = []
+            for i in range(args.requests):
+                prompt = rng.integers(1, cfg.vocab_size,
+                                      (int(rng.integers(3, 12)),)
+                                      ).astype(np.int32)
+                sampling = (SamplingParams() if i % 2 == 0 else
+                            SamplingParams(temperature=0.7, top_k=8,
+                                           seed=i))
+                reqs.append(router.submit(prompt, max_new_tokens=8,
+                                          sampling=sampling,
+                                          tenant=names[i % len(names)]))
+                router.step()
+            router.run_until_idle()
+            for r in reqs:
+                hops = "->".join(map(str, r.replicas))
+                print(f"req {r.id} (replica {hops}, {r.tenant}, "
+                      f"{r.finish_reason}, retries {r.retries}): "
+                      f"{r.prompt.tolist()} -> {r.tokens}")
         print("router summary:", router.summary())
         router.close()
         ptd.destroy_process_group()

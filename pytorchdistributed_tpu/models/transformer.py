@@ -192,6 +192,14 @@ class TransformerConfig:
     # online softmax reassociates the reduction). Multi-token chunks
     # (prefill, speculative verify) always take the gather path.
     paged_attn: str = "gather"          # gather | pallas
+    # Per-slot sink/window overrides (ISSUE 15): the slot-batch decode
+    # models read sink/window from per-slot ``kv_sinks``/``kv_windows``
+    # cache leaves (host-stamped by the serving engine) instead of the
+    # static cfg values — what lets one request decode under a tighter
+    # window than the pool's. Gather path only (the Pallas kernel takes
+    # sink/window as STATIC parameters); off by default so the static
+    # mask — and every pinned HLO — is byte-identical.
+    per_slot_kv_limits: bool = False
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -607,6 +615,20 @@ class SelfAttention(nn.Module):
                     "cache", "block_table",
                     lambda: jnp.zeros((cfg.decode_slots, cfg.kv_pages),
                                       jnp.int32))
+                if cfg.per_slot_kv_limits and cfg.kv_window_tokens:
+                    # per-slot sink/window (ISSUE 15): cache leaves only
+                    # so they ride the collection plumbing — the engine
+                    # host-stamps them on admission/release, defaulting
+                    # to the cfg statics, and the mask below reads each
+                    # slot's own values
+                    sinks_var = self.variable(
+                        "cache", "kv_sinks",
+                        lambda: jnp.full((cfg.decode_slots,),
+                                         cfg.kv_sink_tokens, jnp.int32))
+                    windows_var = self.variable(
+                        "cache", "kv_windows",
+                        lambda: jnp.full((cfg.decode_slots,),
+                                         cfg.kv_window_tokens, jnp.int32))
                 cached_k = self.variable(
                     "cache", "cached_key", jnp.zeros,
                     (cfg.kv_blocks, bs_blk, cfg.kv_heads, cfg.head_dim),
@@ -758,8 +780,19 @@ class SelfAttention(nn.Module):
                     # engine retires to the allocator, so the gathered
                     # garbage there is masked before the softmax
                     j = jnp.arange(attend)
-                    valid &= ((j < cfg.kv_sink_tokens)
-                              | (j > pos[..., None] - cfg.kv_window_tokens))
+                    if cfg.per_slot_kv_limits and cfg.kv_block_size:
+                        # per-slot values (ISSUE 15): with every slot at
+                        # the cfg defaults this computes the identical
+                        # valid mask, so untouched streams stay bitwise
+                        snk = sinks_var.value[:, None, None]
+                        win = windows_var.value[:, None, None]
+                        valid &= ((j[None, None, :] < snk)
+                                  | (j[None, None, :]
+                                     > pos[..., None] - win))
+                    else:
+                        valid &= ((j < cfg.kv_sink_tokens)
+                                  | (j > pos[..., None]
+                                     - cfg.kv_window_tokens))
                 scores = jnp.einsum("bihd,bjhd->bhij", q, kc,
                                     preferred_element_type=jnp.float32)
                 scores = scores / jnp.sqrt(cfg.head_dim).astype(jnp.float32)

@@ -29,11 +29,35 @@
                    FleetPrefixIndex steers shared prefixes to the
                    replica that already holds them (or ships the
                    blocks), so a hot prefix is prefilled once per fleet
+  * admission.py — AdmissionController (ISSUE 15): multi-tenant
+                   admission — per-tenant queues under a priority-
+                   tiered weighted-deficit-round-robin token scheduler,
+                   per-tenant rate/queue caps, weighted shedding that
+                   never touches a compliant tenant
+  * autoscale.py — Autoscaler + SLOConfig (ISSUE 15): the control loop
+                   that turns sustained SLO breaches in the router's
+                   signal rings into warm add_replica / graceful
+                   remove_replica, with hysteresis, cooldowns and
+                   independent prefill/decode pool scaling
+  * traffic.py   — seeded trace generators (steady/diurnal/flash,
+                   heavy-tail lengths, shared-prefix tenant mixes) and
+                   the fake-clock replay() driver the bench and the
+                   quick test tier share
 
 `bench.py --mode serve` drives it under a Poisson arrival trace (plus
-the paged capacity and prefix-reuse A/Bs); examples/serve.py is the
-train-then-serve demo.
+the paged capacity, prefix-reuse and autoscale A/Bs); examples/serve.py
+is the train-then-serve demo.
 """
+
+from pytorchdistributed_tpu.serving.admission import (  # noqa: F401
+    DEFAULT_TENANT,
+    AdmissionController,
+    TenantConfig,
+)
+from pytorchdistributed_tpu.serving.autoscale import (  # noqa: F401
+    Autoscaler,
+    SLOConfig,
+)
 
 from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
     KVBlockPayload,
@@ -59,8 +83,10 @@ from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
 )
 from pytorchdistributed_tpu.serving.router import (  # noqa: F401
     DEAD,
+    DRAINING,
     HEALTHY,
     QUARANTINED,
+    REMOVED,
     ROLE_BOTH,
     ROLE_DECODE,
     ROLE_PREFILL,
@@ -78,4 +104,12 @@ from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
     SERVE_METRICS_GLOB,
     RouterTelemetry,
     ServingTelemetry,
+    SignalRing,
+)
+from pytorchdistributed_tpu.serving.traffic import (  # noqa: F401
+    FakeClock,
+    TenantTraffic,
+    TrafficRequest,
+    make_trace,
+    replay,
 )

@@ -1,0 +1,349 @@
+"""Multi-tenant admission control (ISSUE 15): per-tenant queues under a
+weighted deficit-round-robin token-budget scheduler, replacing the
+router's single FIFO when tenancy is enabled.
+
+Design constraints, in order:
+
+  * **Drop-in for the router's queue.** The ReplicaRouter touches its
+    queue through exactly the deque surface — ``append`` /
+    ``appendleft`` / ``popleft`` / ``remove`` / ``len`` / iteration —
+    so the controller implements that protocol and the router swaps it
+    in as ``self._queue`` untouched: failover requeues
+    (``appendleft``), the dispatch loop (``popleft``), deadline expiry
+    (iterate + ``remove``) and drain all keep working. The ONE new
+    entry point is ``offer()``: the policed admission path
+    ``ReplicaRouter.submit`` calls instead of ``append``.
+
+  * **Token-budget fairness, not request counts.** A request's cost is
+    ``prompt_len + max_new_tokens`` — the slot-time it will actually
+    consume — so one tenant's 4k-token monsters can't starve another's
+    one-liners by arriving at the same request rate. Scheduling is
+    weighted deficit round-robin: each pop replenishes the competing
+    tenants' deficit counters by ``quantum * weight`` rounds until one
+    can afford its head, then serves the next affordable tenant in
+    round-robin order. A tenant whose queue empties forfeits its
+    deficit (classic DRR — no banking idle time).
+
+  * **Strict priority tiers above fairness.** ``priority`` 0 is
+    highest; WDRR only arbitrates among the tenants whose HEAD request
+    sits in the best (lowest) priority tier currently queued.
+
+  * **Weighted shedding, never from a compliant tenant.** When the
+    global queue cap is hit, the victim is the newest queued request
+    of the tenant FURTHEST OVER its weighted admitted-token share —
+    the arrival itself when the arriving tenant is the most over. A
+    tenant at or under its guarantee can lose work only to its own
+    per-tenant caps (``max_queued``, rate bucket), never to another
+    tenant's overload: the fairness property tests pin shed == 0 for a
+    compliant tenant against a 10x hot neighbour.
+
+  * **Pressure -> tighter windows.** With ``priority_windows`` set,
+    once the backlog passes ``pressure_depth`` an admitted request's
+    per-request KV window (ISSUE 15 satellite of ROADMAP item 2) is
+    clamped to its priority class's budget — background traffic decodes
+    under a short sliding window while the queue is deep, freeing pool
+    blocks for latency-sensitive tiers.
+
+Everything is pure host state; the only clock is the injectable
+``clock=`` the rate buckets read, so tests drive it with a FakeClock.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import math
+import time
+
+__all__ = ["DEFAULT_TENANT", "AdmissionController", "TenantConfig"]
+
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's admission contract.
+
+    weight: WDRR share — guarantees ``weight / sum(weights)`` of
+      admitted token throughput while the tenant has demand.
+    max_queued: per-tenant backlog cap (requests); arrivals past it
+      shed immediately, regardless of global queue room.
+    rate_tokens_per_s: token-bucket rate cap on ADMITTED token cost
+      (prompt + budget); None = uncapped.
+    burst_s: bucket depth in seconds of the rate — how far above the
+      sustained rate a burst may momentarily go.
+    """
+
+    weight: float = 1.0
+    max_queued: int | None = None
+    rate_tokens_per_s: float | None = None
+    burst_s: float = 2.0
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_queued is not None and self.max_queued < 1:
+            raise ValueError(
+                f"max_queued must be >= 1, got {self.max_queued}")
+        if (self.rate_tokens_per_s is not None
+                and self.rate_tokens_per_s <= 0):
+            raise ValueError(f"rate_tokens_per_s must be > 0, got "
+                             f"{self.rate_tokens_per_s}")
+
+
+class AdmissionController:
+    """Per-tenant queues + WDRR scheduler behind the router's deque
+    protocol. Unknown tenants get ``default_config`` lazily, so an
+    untenanted ``submit()`` still works (everything lands on the
+    ``"default"`` tenant and the controller degrades to plain FIFO)."""
+
+    def __init__(self, tenants: dict[str, TenantConfig] | None = None, *,
+                 default_config: TenantConfig | None = None,
+                 max_queue: int | None = None, quantum_tokens: int = 64,
+                 clock=time.monotonic, pressure_depth: int | None = None,
+                 priority_windows: dict[int, int] | None = None):
+        if quantum_tokens < 1:
+            raise ValueError(
+                f"quantum_tokens must be >= 1, got {quantum_tokens}")
+        self._cfgs: dict[str, TenantConfig] = dict(tenants or {})
+        self._default = default_config or TenantConfig()
+        self._max_queue = max_queue
+        self._quantum = float(quantum_tokens)
+        self._clock = clock
+        self._pressure_depth = pressure_depth
+        self._priority_windows = dict(priority_windows or {})
+        self._order: list[str] = []
+        self._queues: dict[str, collections.deque] = {}
+        self._deficit: dict[str, float] = {}
+        self._charged: dict[str, float] = {}   # admitted token cost
+        self._served: dict[str, float] = {}    # scheduled token cost
+        self._bucket: dict[str, float] = {}
+        self._bucket_t: dict[str, float] = {}
+        self._rr = 0
+        # register declared tenants up front: a declared-but-idle
+        # tenant still shapes the weight denominator
+        for name in self._cfgs:
+            self._ensure(name)
+
+    # -- config / bookkeeping ------------------------------------------
+
+    def config(self, name: str) -> TenantConfig:
+        return self._cfgs.get(name, self._default)
+
+    def _ensure(self, name: str) -> None:
+        if name not in self._queues:
+            self._order.append(name)
+            self._queues[name] = collections.deque()
+            self._deficit[name] = 0.0
+            self._charged[name] = 0.0
+            self._served[name] = 0.0
+            cfg = self.config(name)
+            if cfg.rate_tokens_per_s:
+                self._bucket[name] = cfg.rate_tokens_per_s * cfg.burst_s
+                self._bucket_t[name] = self._clock()
+
+    @staticmethod
+    def _cost(rr) -> float:
+        return float(int(rr.prompt.size) + int(rr.max_new_tokens))
+
+    @staticmethod
+    def _tenant_of(rr) -> str:
+        name = getattr(rr, "tenant", None)
+        return name if name is not None else DEFAULT_TENANT
+
+    def _refill(self, name: str, cfg: TenantConfig) -> None:
+        now = self._clock()
+        cap = cfg.rate_tokens_per_s * cfg.burst_s
+        self._bucket[name] = min(
+            cap, self._bucket[name]
+            + cfg.rate_tokens_per_s * (now - self._bucket_t[name]))
+        self._bucket_t[name] = now
+
+    # -- the policed admission path ------------------------------------
+
+    def offer(self, rr):
+        """Admit ``rr`` or pick what sheds for it. Returns None when
+        admitted with room; otherwise the request the router must shed
+        — ``rr`` itself (per-tenant cap, rate cap, or the arriving
+        tenant is the one most over budget) or an evicted queued
+        request from the most-over-budget tenant (``rr`` then takes
+        the freed spot). The caller owns finishing the victim."""
+        name = self._tenant_of(rr)
+        rr.tenant = name
+        self._ensure(name)
+        cfg = self.config(name)
+        cost = self._cost(rr)
+        q = self._queues[name]
+        if cfg.max_queued is not None and len(q) >= cfg.max_queued:
+            return rr
+        if cfg.rate_tokens_per_s:
+            self._refill(name, cfg)
+            if self._bucket[name] < cost:
+                return rr
+        victim = None
+        if self._max_queue is not None and len(self) >= self._max_queue:
+            victim = self._pick_victim(rr)
+            if victim is rr:
+                return rr
+        if cfg.rate_tokens_per_s:
+            self._bucket[name] -= cost
+        if (self._priority_windows and self._pressure_depth is not None
+                and len(self) >= self._pressure_depth):
+            w = self._priority_windows.get(int(getattr(rr, "priority", 0)))
+            if w is not None and (getattr(rr, "kv_window", None) is None
+                                  or w < rr.kv_window):
+                rr.kv_window = w
+        q.append(rr)
+        self._charged[name] += cost
+        return victim
+
+    def _pick_victim(self, rr):
+        """The weighted-shedding rule: the tenant furthest over its
+        weighted share of admitted token cost loses its NEWEST queued
+        request (oldest work is closest to a slot — shedding it wastes
+        the most). A tenant at/under its guarantee is untouchable; if
+        the arriving tenant is the most over (or nobody is over), the
+        arrival itself sheds."""
+        arriving = self._tenant_of(rr)
+        over = self.overages()
+        live = [n for n in self._order
+                if self._queues[n] or n == arriving]
+        worst = max(live, key=lambda n: (over.get(n, 0.0), n == arriving))
+        if (worst == arriving or over.get(worst, 0.0) <= 0.0
+                or not self._queues[worst]):
+            return rr
+        victim = self._queues[worst].pop()
+        self._charged[worst] -= self._cost(victim)
+        return victim
+
+    def overages(self) -> dict[str, float]:
+        """Per-tenant (admitted token share - weight share): > 0 means
+        the tenant has taken more than its guarantee, <= 0 means it is
+        compliant. Tenants that never appeared don't exist yet."""
+        names = self._order
+        if not names:
+            return {}
+        tw = sum(self.config(n).weight for n in names)
+        tc = sum(self._charged[n] for n in names)
+        if tc <= 0:
+            return {n: 0.0 for n in names}
+        return {n: self._charged[n] / tc - self.config(n).weight / tw
+                for n in names}
+
+    def starved_head(self):
+        """The head request of the best-priority COMPLIANT tenant with
+        work queued (None when every queued tenant is over budget) —
+        the router's preemption trigger: if this exists while the
+        fleet is saturated by over-budget residents, one of theirs
+        goes back to the queue."""
+        over = self.overages()
+        best = None
+        for n in self._order:
+            if self._queues[n] and over.get(n, 0.0) <= 0.0:
+                head = self._queues[n][0]
+                if best is None or head.priority < best.priority:
+                    best = head
+        return best
+
+    # -- the deque protocol the router already speaks ------------------
+
+    def append(self, rr) -> None:
+        """Unpoliced enqueue (internal requeue paths); use ``offer``
+        for arrivals."""
+        name = self._tenant_of(rr)
+        rr.tenant = name
+        self._ensure(name)
+        self._queues[name].append(rr)
+
+    def appendleft(self, rr) -> None:
+        """Head-of-line requeue (failover / preemption / dispatch
+        deferral): the request was already admitted once — no caps, no
+        re-charge."""
+        name = self._tenant_of(rr)
+        rr.tenant = name
+        self._ensure(name)
+        self._queues[name].appendleft(rr)
+
+    def popleft(self):
+        """WDRR pop: among the tenants whose head sits in the best
+        queued priority tier, replenish deficits by whole
+        ``quantum * weight`` rounds until someone can afford their
+        head, then serve the next affordable tenant in round-robin
+        order."""
+        live = [n for n in self._order if self._queues[n]]
+        if not live:
+            raise IndexError("pop from an empty admission queue")
+        top = min(self._queues[n][0].priority for n in live)
+        cands = [n for n in live if self._queues[n][0].priority == top]
+        costs = {n: self._cost(self._queues[n][0]) for n in cands}
+
+        def rounds_needed(n):
+            inc = self._quantum * self.config(n).weight
+            return max(0, math.ceil((costs[n] - self._deficit[n]) / inc))
+
+        k = min(rounds_needed(n) for n in cands)
+        if k:
+            for n in cands:
+                self._deficit[n] += k * self._quantum * self.config(n).weight
+        eligible = [n for n in cands if self._deficit[n] >= costs[n]]
+        if not eligible:  # float-rounding edge: force the closest one
+            eligible = [min(cands, key=rounds_needed)]
+        pick = None
+        for j in range(len(self._order)):
+            n = self._order[(self._rr + j) % len(self._order)]
+            if n in eligible:
+                pick = n
+                self._rr = (self._rr + j + 1) % len(self._order)
+                break
+        q = self._queues[pick]
+        rr = q.popleft()
+        self._deficit[pick] -= costs[pick]
+        self._served[pick] += costs[pick]
+        if not q:
+            self._deficit[pick] = 0.0
+        return rr
+
+    def remove(self, rr) -> None:
+        name = self._tenant_of(rr)
+        q = self._queues.get(name)
+        if q is not None:
+            try:
+                q.remove(rr)
+                return
+            except ValueError:
+                pass
+        for q in self._queues.values():  # tenant tag changed under us
+            try:
+                q.remove(rr)
+                return
+            except ValueError:
+                continue
+        raise ValueError("request not queued")
+
+    def __iter__(self):
+        return itertools.chain.from_iterable(
+            list(self._queues[n]) for n in self._order)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def __bool__(self) -> bool:
+        return any(self._queues.values())
+
+    # -- observability -------------------------------------------------
+
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant snapshot for summaries/reports: queue depth,
+        weight, admitted/served token cost, current overage."""
+        over = self.overages()
+        return {
+            n: {
+                "queued": len(self._queues[n]),
+                "weight": self.config(n).weight,
+                "charged_tokens": round(self._charged[n], 1),
+                "served_tokens": round(self._served[n], 1),
+                "overage": round(over.get(n, 0.0), 4),
+            }
+            for n in self._order
+        }

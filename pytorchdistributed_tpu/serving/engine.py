@@ -223,7 +223,8 @@ def prefill_into_slot(model, weights, cache, prompt, true_len, slot,
 def paged_slot_models(model, num_slots: int, block_size: int,
                       num_blocks: int, *, kv_dtype: str = "bf16",
                       kv_sink_tokens: int = 0, kv_window_tokens: int = 0,
-                      paged_attn: str = "gather"):
+                      paged_attn: str = "gather",
+                      per_slot_kv_limits: bool = False):
     """(tick_model, chunk_model) for the PAGED engine: both share the one
     block pool (pool shapes carry no slot dim); the tick model decodes
     all ``num_slots`` rows, the chunk model runs one request's prefill
@@ -233,15 +234,22 @@ def paged_slot_models(model, num_slots: int, block_size: int,
     dtype (int8 adds the scale-plane cache leaves), sink/window set the
     STATIC attention-window mask, and ``paged_attn`` picks the decode
     tick's attention implementation (the chunked-prefill path always
-    gathers — chunks run s > 1, the Pallas kernel is decode-only)."""
+    gathers — chunks run s > 1, the Pallas kernel is decode-only).
+    ``per_slot_kv_limits`` (ISSUE 15) swaps the static window mask for
+    per-slot ``kv_sinks``/``kv_windows`` cache leaves on the TICK model
+    only — the chunk model keeps the static mask (one request's prefill
+    has no slot row to read), so prefill always masks under the pool
+    window and the per-request override takes effect from the first
+    decoded token."""
     cfg = dataclasses.replace(
         model.cfg, decode=True, attention="dense", decode_attend_len=None,
         decode_slots=num_slots, kv_block_size=block_size,
         kv_blocks=num_blocks, kv_dtype=kv_dtype,
         kv_sink_tokens=kv_sink_tokens, kv_window_tokens=kv_window_tokens,
-        paged_attn=paged_attn)
+        paged_attn=paged_attn, per_slot_kv_limits=per_slot_kv_limits)
     return (model.clone(cfg=cfg),
-            model.clone(cfg=dataclasses.replace(cfg, decode_slots=1)))
+            model.clone(cfg=dataclasses.replace(
+                cfg, decode_slots=1, per_slot_kv_limits=False)))
 
 
 def _override_paging(cache, tables, lengths):
@@ -671,6 +679,11 @@ class Request:
         # accepted/draft is the request's acceptance rate
         self.draft_tokens = 0
         self.accepted_tokens = 0
+        # per-request KV window/sink override (ISSUE 15): the EFFECTIVE
+        # values after submit() clamps to the pool config; None = the
+        # engine-static defaults
+        self.kv_window: int | None = None
+        self.kv_sink: int | None = None
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -868,15 +881,23 @@ class ServingEngine:
                     f"max_seq_len/block_size + the trash block)")
             self.block_size = block_size
             self.num_blocks = num_blocks
+            # per-request window/sink overrides (ISSUE 15) need the
+            # per-slot mask leaves; the Pallas kernel takes sink/window
+            # STATICALLY, so overrides stay gather-only and a pallas
+            # pool keeps the exact PR 12 program
+            self.per_slot_limits = bool(self.kv_window_tokens
+                                        and self.paged_attn != "pallas")
             self._tick_model, self._chunk_model = paged_slot_models(
                 model, num_slots, block_size, num_blocks,
                 kv_dtype=kv_dtype, kv_sink_tokens=self.kv_sink_tokens,
                 kv_window_tokens=self.kv_window_tokens,
-                paged_attn=self.paged_attn)
+                paged_attn=self.paged_attn,
+                per_slot_kv_limits=self.per_slot_limits)
             self._prefill_model = None
         else:
             self.block_size = 0
             self.num_blocks = 0
+            self.per_slot_limits = False
             self._tick_model, self._prefill_model = slot_models(
                 model, num_slots)
         self.cfg = self._tick_model.cfg
@@ -904,6 +925,20 @@ class ServingEngine:
             # the blocks but leaves the tick's view (all-trash table,
             # length 0) until export_kv_blocks takes custody
             self._prefilled: dict[int, dict] = {}
+            # per-slot EFFECTIVE sink/window (ISSUE 15): engine defaults
+            # until a request with an override activates in the slot.
+            # Host truth for both the compiled mask (stamped into the
+            # kv_sinks/kv_windows cache leaves when dirty) and the
+            # block-retirement sweep — the two MUST agree, or retirement
+            # would point still-attended positions at the trash block
+            self._slot_sinks = np.full(num_slots, self.kv_sink_tokens,
+                                       np.int32)
+            self._slot_windows = np.full(num_slots, self.kv_window_tokens,
+                                         np.int32)
+            # dirty from birth: _zero_cache zeroes the kv_sinks/
+            # kv_windows leaves too (Flax init defaults never run), so
+            # the engine defaults must be stamped before the first tick
+            self._limits_dirty = self.per_slot_limits
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.spec_k = spec_k
@@ -937,7 +972,8 @@ class ServingEngine:
                 paged_slot_models(draft_base, num_slots, self.block_size,
                                   self.num_blocks, kv_dtype=kv_dtype,
                                   kv_sink_tokens=self.kv_sink_tokens,
-                                  kv_window_tokens=self.kv_window_tokens)
+                                  kv_window_tokens=self.kv_window_tokens,
+                                  per_slot_kv_limits=self.per_slot_limits)
             self._draft_weights = (draft_params["params"]
                                    if "params" in draft_params
                                    else draft_params)
@@ -998,7 +1034,9 @@ class ServingEngine:
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None, stop_ids=None,
                on_token=None, deadline_s: float | None = None,
-               generated=None, prefill_only: bool = False) -> Request:
+               generated=None, prefill_only: bool = False,
+               kv_window: int | None = None,
+               kv_sink: int | None = None) -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
         steps). ``stop_ids`` accepts a single id or a sequence.
@@ -1027,7 +1065,45 @@ class ServingEngine:
         K/V blocks wait for ``export_kv_blocks`` to hand them to a
         decode-role replica. A request already done at its first token
         (stop id / max_new_tokens == 1) finishes normally and never
-        parks."""
+        parks.
+
+        ``kv_window`` / ``kv_sink`` (ISSUE 15) TIGHTEN this request's
+        sliding-window attention below the pool's static config: values
+        are clamped to the pool's (you can never widen past what every
+        slot's HBM budget was sized for) and rounded up to whole
+        blocks (retirement granularity). They take effect from the
+        first DECODED token — prefill masks under the pool window —
+        and the retirement sweep frees the request's dead blocks at
+        its own tighter horizon. Requires a windowed gather-path pool:
+        a dense engine, a windowless pool (there are no mask leaves to
+        stamp — the compiled programs are exactly PR 12's), the Pallas
+        kernel (sink/window are STATIC kernel parameters there) and
+        ``prefill_only`` handoffs (the KV wire carries no per-request
+        window) all reject loudly."""
+        if kv_window is not None or kv_sink is not None:
+            if not self.paged:
+                raise ValueError(
+                    "per-request kv_window/kv_sink need the paged engine "
+                    "(block_size > 0)")
+            if not self.kv_window_tokens:
+                raise ValueError(
+                    "per-request kv_window/kv_sink need a windowed pool "
+                    "(engine kv_window_tokens > 0): a windowless pool "
+                    "compiles no per-slot mask leaves")
+            if not self.per_slot_limits:
+                raise ValueError(
+                    "per-request kv_window/kv_sink need paged_attn="
+                    "'gather' — the Pallas kernel takes sink/window as "
+                    "STATIC parameters")
+            if prefill_only:
+                raise ValueError(
+                    "per-request kv_window/kv_sink do not ride the KV "
+                    "handoff wire — submit them on the decode replica")
+            if kv_window is not None and kv_window < 1:
+                raise ValueError(
+                    f"kv_window must be >= 1, got {kv_window}")
+            if kv_sink is not None and kv_sink < 0:
+                raise ValueError(f"kv_sink must be >= 0, got {kv_sink}")
         if prefill_only:
             if not self.paged:
                 raise ValueError(
@@ -1059,6 +1135,17 @@ class ServingEngine:
                       stop_ids_tuple(stop_ids), on_token,
                       deadline_s=deadline_s, generated=generated)
         req.prefill_only = prefill_only
+        if kv_window is not None or kv_sink is not None:
+            # clamp to the pool config (tighten-only) and round UP to
+            # whole blocks — retirement frees whole blocks, and a
+            # window shorter than one block would retire the block the
+            # next write needs
+            bs = self.block_size
+            win = self.kv_window_tokens if kv_window is None else kv_window
+            win = min(self.kv_window_tokens, self._round_up(win, bs))
+            sink = self.kv_sink_tokens if kv_sink is None else kv_sink
+            sink = min(self.kv_sink_tokens, self._round_up(sink, bs))
+            req.kv_window, req.kv_sink = int(win), int(sink)
         req.submit_time = time.perf_counter()
         self._queue.append(req)
         return req
@@ -1088,6 +1175,8 @@ class ServingEngine:
         decoded = 0
         if self.paged and self._active:
             self._grow_slots()  # back this tick's write positions
+        if self.per_slot_limits and self._limits_dirty:
+            self._stamp_slot_limits()
         if self._active and self.spec_k:
             decoded = self._spec_step()
         elif self._active:
@@ -1407,6 +1496,8 @@ class ServingEngine:
                 self._note_ttft(now - req.submit_time)
         self._active[slot] = req
         self._admit_order[slot] = next(self._admit_seq)
+        if self.per_slot_limits:
+            self._set_slot_limits(slot, req.kv_sink, req.kv_window)
         self._key_data[slot] = pf["kd"]
         self._counts[slot] = pf["resume"] + 1
         self._temps[slot] = req.sampling.temperature
@@ -1450,11 +1541,19 @@ class ServingEngine:
         the very growth loop below — a long stream's footprint is
         sink + window + a block, not its whole history."""
         bs = self.block_size
-        win, sink = self.kv_window_tokens, self.kv_sink_tokens
         for slot in sorted(self._active,
                            key=lambda s: self._admit_order[s]):
             if slot not in self._active:
                 continue  # preempted by an older slot's growth
+            # retirement horizon = this slot's EFFECTIVE sink/window
+            # (per-request overrides, ISSUE 15) — must agree with the
+            # compiled mask's per-slot leaves or retired garbage would
+            # be attended
+            if self.per_slot_limits:
+                win = int(self._slot_windows[slot])
+                sink = int(self._slot_sinks[slot])
+            else:
+                win, sink = self.kv_window_tokens, self.kv_sink_tokens
             blocks = self._slot_blocks[slot]
             if win:
                 qlo = int(self._lengths[slot])  # this tick's first query
@@ -1480,6 +1579,81 @@ class ServingEngine:
                 if victim == slot:
                     break  # this very request went back to the queue
 
+    def _set_slot_limits(self, slot: int, sink: int | None,
+                         window: int | None) -> None:
+        """Record one slot's effective sink/window (None = engine
+        defaults) and mark the compiled mask leaves stale — they are
+        re-stamped lazily before the next tick."""
+        s = self.kv_sink_tokens if sink is None else sink
+        w = self.kv_window_tokens if window is None else window
+        if (self._slot_sinks[slot] != s
+                or self._slot_windows[slot] != w):
+            self._slot_sinks[slot] = s
+            self._slot_windows[slot] = w
+            self._limits_dirty = True
+
+    def _stamp_slot_limits(self) -> None:
+        """Push the host per-slot sink/window vectors into the cache's
+        ``kv_sinks``/``kv_windows`` leaves (every layer reads the same
+        row — broadcast up the scan axis, exactly like
+        _override_paging's table stamp, just host-initiated because
+        the values change on admission/release, not every tick)."""
+        sinks = jnp.asarray(self._slot_sinks)
+        windows = jnp.asarray(self._slot_windows)
+
+        def fix(path, leaf):
+            name = _leaf_name(path)
+            if name == "kv_sinks":
+                return jnp.broadcast_to(sinks, leaf.shape).astype(leaf.dtype)
+            if name == "kv_windows":
+                return jnp.broadcast_to(windows,
+                                        leaf.shape).astype(leaf.dtype)
+            return leaf
+
+        with self._mesh_ctx():
+            self._cache = jax.tree_util.tree_map_with_path(fix, self._cache)
+            if self.spec_k:
+                self._draft_cache = jax.tree_util.tree_map_with_path(
+                    fix, self._draft_cache)
+        self._limits_dirty = False
+
+    def preempt_request(self, req: Request) -> bool:
+        """Release ``req``'s resources NOW and retire it with
+        finish_reason "preempted", keeping every delivered token — the
+        ROUTER-level preemption hook (ISSUE 15): the router requeues
+        the stream and a later submit(generated=req.new_tokens) resumes
+        it losslessly, exactly like failover redispatch. Queued
+        requests just leave the queue; an active slot's blocks return
+        to the pool. Returns False (no-op) for requests this engine
+        cannot cleanly release mid-flight: already done, mid-chunked-
+        prefill, or parked for KV handoff."""
+        if req.done:
+            return False
+        if req in self._queue:
+            self._queue.remove(req)
+        elif (self.paged and self._prefilling is not None
+                and self._prefilling["req"] is req):
+            return False
+        elif self.paged and req.id in self._prefilled:
+            return False
+        elif req.slot is not None and self._active.get(req.slot) is req:
+            slot = req.slot
+            del self._active[slot]
+            if self.paged:
+                self._release_slot(slot)
+            else:
+                self._free.append(slot)
+                self._temps[slot] = 0.0
+            req.slot = None
+            req.preemptions += 1
+        else:
+            return False
+        req.done = True
+        req.finish_reason = "preempted"
+        req.finish_time = time.perf_counter()
+        self._stats["preempted_requests"] += 1
+        return True
+
     def _preempt(self, slot: int) -> None:
         req = self._active.pop(slot)
         self._release_slot(slot)
@@ -1500,6 +1674,8 @@ class ServingEngine:
         self._slot_blocks[slot] = []
         self._tables[slot, :] = 0
         self._lengths[slot] = 0
+        if self.per_slot_limits:
+            self._set_slot_limits(slot, None, None)
         self._free.append(slot)
         self._temps[slot] = 0.0
 
@@ -2015,7 +2191,12 @@ class ServingEngine:
                     f"spec_k={self.spec_k};kvd={self.kv_dtype};"
                     f"sink={self.kv_sink_tokens};"
                     f"win={self.kv_window_tokens};"
-                    f"pattn={self.paged_attn}")
+                    f"pattn={self.paged_attn};"
+                    # per-slot KV limits change the cache tree (kv_sinks/
+                    # kv_windows leaves) — a stale windowed executable from
+                    # before ISSUE 15 would deserialize against the wrong
+                    # donation layout, so the flag is part of the key
+                    f"pslot={int(self.per_slot_limits)}")
 
         def compile_fn():
             return jit_fn.lower(*statics, *args, **kw_statics).compile()
@@ -2213,7 +2394,8 @@ class ServingEngine:
                            # paged-mode counters (stay 0 on dense)
                            admissions=0, admitted_tokens=0,
                            prefix_hit_tokens=0, prefill_chunks=0,
-                           preemptions=0, block_used_sum=0.0,
+                           preemptions=0, preempted_requests=0,
+                           block_used_sum=0.0,
                            # KV-compression counters (ISSUE 13):
                            # high-water pool occupancy in blocks (the
                            # kv_bytes_resident numerator) and blocks
@@ -2293,6 +2475,7 @@ class ServingEngine:
             out["paged_attn"] = self.paged_attn
             out["prefill_chunks"] = st["prefill_chunks"]
             out["preemptions"] = st["preemptions"]
+            out["preempted_requests"] = st["preempted_requests"]
             out["block_utilization"] = (
                 round(st["block_used_sum"] / st["ticks"], 4)
                 if st["ticks"] else None)

@@ -242,7 +242,9 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                     deadline_s=op.get("deadline_s"),
                     generated=op.get("generated") or None,
                     on_token=on_token,
-                    prefill_only=bool(op.get("prefill_only")))
+                    prefill_only=bool(op.get("prefill_only")),
+                    kv_window=op.get("kv_window"),
+                    kv_sink=op.get("kv_sink"))
             except ValueError as e:
                 # a malformed request must cost ONE refusal, not the
                 # worker process (and then, replica by replica, the
@@ -338,6 +340,16 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
             adopted = engine.import_prefix_blocks(
                 prefix_payload_from_wire(op["payload"]))
             reply(ok=True, adopted=int(adopted))
+        elif kind == "preempt":
+            # admission-side preemption (ISSUE 15): evict the stream
+            # losslessly — its tokens flow back as a "preempted" finish
+            # through the next step reply and the router requeues it
+            req = reqs.get(op["rid"])
+            ok = req is not None and engine.preempt_request(req)
+            if ok:
+                finished.append([op["rid"], "preempted"])
+                del reqs[op["rid"]]
+            reply(ok=bool(ok), rid=op["rid"])
         elif kind == "probe":
             reply(finite=engine.check_params_finite())
         elif kind == "drain":

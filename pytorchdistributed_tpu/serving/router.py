@@ -92,8 +92,13 @@ from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
 #: Replica lifecycle states. HEALTHY serves traffic; QUARANTINED is
 #: alive but sick (params non-finite) — probed every tick, rejoined
 #: after a clean streak + canary; DEAD is crashed or hung (its requests
-#: were failed over) and never returns.
+#: were failed over) and never returns. ISSUE 15 adds the scale-down
+#: pair: DRAINING still steps (resident streams finish, parked prefills
+#: hand off) but admits nothing new, and REMOVED is a tombstone — the
+#: parallel per-replica lists are never renumbered, so a removed
+#: replica's counters and occupancy history survive into the summary.
 HEALTHY, QUARANTINED, DEAD = "healthy", "quarantined", "dead"
+DRAINING, REMOVED = "draining", "removed"
 
 #: Replica roles (ISSUE 12 — prefill/decode disaggregation). A
 #: ``prefill``-role replica runs chunked prefill only: its requests are
@@ -142,7 +147,10 @@ class RouterRequest:
 
     def __init__(self, prompt, max_new_tokens: int,
                  sampling: SamplingParams, stop_ids, on_token=None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 tenant: str | None = None, priority: int = 0,
+                 kv_window: int | None = None,
+                 kv_sink: int | None = None):
         self.id = next(RouterRequest._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = max_new_tokens
@@ -150,6 +158,14 @@ class RouterRequest:
         self.stop_ids = stop_ids
         self.on_token = on_token
         self.deadline_s = deadline_s
+        # multi-tenancy (ISSUE 15): the admission controller schedules,
+        # rate-limits and sheds by tenant; priority 0 is highest
+        self.tenant = tenant or "default"
+        self.priority = int(priority)
+        # per-request KV limits (tighten-only; the replica's engine
+        # clamps to its pool config and may REFUSE incompatible pools)
+        self.kv_window = kv_window
+        self.kv_sink = kv_sink
         self.tokens: list[int] = []          # the delivered stream
         self.done = False
         self.finish_reason: str | None = None
@@ -220,7 +236,15 @@ class InProcessReplica:
             rr.prompt, max_new_tokens=rr.max_new_tokens,
             sampling=rr.sampling, stop_ids=rr.stop_ids,
             deadline_s=deadline_s, generated=generated, on_token=on_token,
-            prefill_only=prefill_only)
+            prefill_only=prefill_only,
+            kv_window=rr.kv_window, kv_sink=rr.kv_sink)
+
+    def preempt(self, rr: RouterRequest) -> bool:
+        """Evict the stream losslessly (admission-pressure preemption):
+        the engine frees its slot/blocks and finishes the handle
+        ``"preempted"`` — the router's reap sweep requeues it."""
+        return (rr._handle is not None
+                and self.engine.preempt_request(rr._handle))
 
     # -- KV block stream (ISSUE 12) -----------------------------------
 
@@ -474,11 +498,31 @@ class SubprocessReplica:
                     "stop_ids": list(rr.stop_ids),
                     "generated": list(generated or []),
                     "deadline_s": deadline_s,
-                    "prefill_only": bool(prefill_only)})
+                    "prefill_only": bool(prefill_only),
+                    "kv_window": rr.kv_window,
+                    "kv_sink": rr.kv_sink})
         self._on_token[rr.id] = on_token
         m = _Mirror()
         self._mirrors[rr.id] = m
         return m
+
+    def preempt(self, rr: RouterRequest) -> bool:
+        """Synchronous preempt roundtrip (rare — admission pressure
+        only, so the one-in-flight wire cost is acceptable, same as a
+        KV handoff). The worker's reply is consumed HERE, not through
+        ``_consume`` — an ok=False preempt must not be mistaken for a
+        submit refusal and fail a perfectly live stream."""
+        self._drain_wire()
+        self._send({"op": "preempt", "rid": rr.id})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        self._pending_op = None
+        if not resp.get("ok"):
+            return False
+        m = self._mirrors.pop(rr.id, None)
+        if m is not None:
+            m.done, m.finish_reason = True, "preempted"
+        self._on_token.pop(rr.id, None)
+        return True
 
     # -- KV block stream (ISSUE 12) -----------------------------------
     # Handoffs are synchronous wire roundtrips by design: the payload
@@ -748,11 +792,13 @@ class ReplicaRouter:
                  respawn_warmup_s: float = 600.0,
                  faults="auto", telemetry: RouterTelemetry | None = None,
                  telemetry_dir=None, sample_every: int = 1,
-                 seed: int = 0):
+                 tenants=None, admission=None,
+                 preempt_every: int = 8, seed: int = 0):
         self.warmup_lens = tuple(warmup_lens) if warmup_lens else None
         self._hb_dir = None
         self._worker_specs = None
         self._worker_port = None
+        self._factory_fn = None
         if workers is not None:
             import tempfile
 
@@ -765,6 +811,10 @@ class ReplicaRouter:
             self._hb_dir = tempfile.mkdtemp(prefix="ptd_router_hb_")
             port = free_port()
             self._worker_specs = list(workers)
+            # scale-up template: a new replica index i reuses spec
+            # i % len(base) — homogeneous fleets (the common case) just
+            # clone spec 0
+            self._base_specs = list(workers)
             self._worker_port = port
             self._replicas = [
                 SubprocessReplica(i, spec, world_size=len(workers),
@@ -804,6 +854,11 @@ class ReplicaRouter:
                     return factory
 
                 factories = [make_factory(i) for i in range(replicas)]
+                self._factory_fn = make_factory
+            else:
+                factories = list(factories)
+                self._factory_fn = (
+                    lambda i, fs=factories: fs[i % len(fs)])
             self._replicas = [
                 InProcessReplica(i, f, warmup_lens=self.warmup_lens)
                 for i, f in enumerate(factories)]
@@ -853,11 +908,38 @@ class ReplicaRouter:
         self._faults = (faults_inject.active() if faults == "auto"
                         else faults)
         self._rng = random.Random(seed)
-        if telemetry is None and telemetry_dir is not None:
+        if telemetry is None:
+            # no dir -> RING-ONLY telemetry: zero files, but the signal
+            # rings / recent-events the autoscaler consumes always exist
             telemetry = RouterTelemetry(telemetry_dir)
         self.telemetry = telemetry
         self.sample_every = max(1, sample_every)
-        self._queue: collections.deque[RouterRequest] = collections.deque()
+        # multi-tenant admission (ISSUE 15): when tenants/admission is
+        # given, the router queue IS the AdmissionController — it speaks
+        # the deque protocol (append/appendleft/popleft/remove/iter), so
+        # every existing queue path (dispatch, failover requeue,
+        # deadline expiry, drain) runs unchanged, but popleft order is
+        # priority-tiered weighted deficit round-robin and submit goes
+        # through offer()'s rate caps + weighted shedding
+        self._admission = None
+        if admission is not None or tenants:
+            from pytorchdistributed_tpu.serving.admission import (
+                AdmissionController,
+            )
+
+            if admission is None:
+                admission = AdmissionController(tenants,
+                                                max_queue=max_queue)
+            self._admission = admission
+            self._queue = admission
+        else:
+            self._queue: collections.deque[RouterRequest] = \
+                collections.deque()
+        self.preempt_every = max(1, preempt_every)
+        self._last_preempt_tick = -10**9
+        self._retiring: set[int] = set()
+        self._first_token_t: dict[int, float] = {}
+        self._last_signal_counts = (0, 0)
         self._assigned: list[dict[int, RouterRequest]] = [
             {} for _ in self._replicas]
         self._status = [HEALTHY for _ in self._replicas]
@@ -880,8 +962,10 @@ class ReplicaRouter:
 
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None, stop_ids=None,
-               on_token=None,
-               deadline_s: float | None = None) -> RouterRequest:
+               on_token=None, deadline_s: float | None = None,
+               tenant: str | None = None, priority: int = 0,
+               kv_window: int | None = None,
+               kv_sink: int | None = None) -> RouterRequest:
         """Queue one request with the router (dispatch to a replica
         happens inside step(), against fresh health snapshots). Returns
         the durable RouterRequest handle — ``handle.tokens`` is the
@@ -906,12 +990,21 @@ class ReplicaRouter:
                 f"prompt_len {prompt.size} + max_new_tokens "
                 f"{max_new_tokens} exceeds max_seq_len "
                 f"{self.max_seq_len}")
+        if kv_window is not None and kv_window < 1:
+            raise ValueError(f"kv_window must be >= 1, got {kv_window}")
+        if kv_sink is not None and kv_sink < 0:
+            raise ValueError(f"kv_sink must be >= 0, got {kv_sink}")
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
         rr = RouterRequest(prompt, max_new_tokens,
                            sampling or SamplingParams(),
                            stop_ids_tuple(stop_ids), on_token,
-                           deadline_s=deadline_s)
+                           deadline_s=deadline_s, tenant=tenant,
+                           priority=priority, kv_window=kv_window,
+                           kv_sink=kv_sink)
         rr.submit_time = time.perf_counter()
         self._stats["submitted"] += 1
+        self._tenant_stats(rr.tenant)["submitted"] += 1
         if self._draining:
             self._finish(rr, "drained")
             return rr
@@ -921,10 +1014,24 @@ class ReplicaRouter:
             # can already hold, so the bound sheds on CAPACITY, not on
             # how recently the caller interleaved a step()
             self._dispatch()
+        if self._admission is not None:
+            # weighted shedding: offer() admits, rate-refuses, or —
+            # when the global bound is hit — picks the victim from the
+            # tenant FURTHEST OVER its weight share (the arrival
+            # itself when its own tenant is the worst offender). A
+            # compliant tenant's requests are untouchable.
+            victim = self._queue.offer(rr)
+            if victim is not None:
+                self._stats["shed_requests"] += 1
+                self._event("shed", request=victim.id,
+                            tenant=victim.tenant,
+                            queued=len(self._queue))
+                self._finish(victim, "shed")
+            return rr
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
             self._stats["shed_requests"] += 1
-            self._event("shed", request=rr.id,
+            self._event("shed", request=rr.id, tenant=rr.tenant,
                         queued=len(self._queue))
             self._finish(rr, "shed")
             return rr
@@ -956,7 +1063,7 @@ class ReplicaRouter:
         # injection that never happened)
         if self._faults is not None:
             for r in self._replicas:
-                if (self._status[r.index] != DEAD
+                if (self._status[r.index] not in (DEAD, REMOVED)
                         and not getattr(r, "faults_in_worker", False)):
                     kind = self._faults.on_serving_tick(self._ticks,
                                                         r.index)
@@ -969,9 +1076,14 @@ class ReplicaRouter:
         self._maybe_respawn()
         # 3. dispatch
         dispatched = self._dispatch()
-        # 4. step replicas
+        # 3b. admission-pressure preemption: a starved compliant tenant
+        # at the head of a saturated fleet may evict an over-budget
+        # tenant's newest stream (losslessly — preempt-requeue)
+        self._maybe_preempt()
+        # 4. step replicas — DRAINING ones too: their resident streams
+        # must finish before the tombstone
         for r in self._replicas:
-            if self._status[r.index] != HEALTHY:
+            if self._status[r.index] not in (HEALTHY, DRAINING):
                 continue
             try:
                 r.step()
@@ -983,9 +1095,13 @@ class ReplicaRouter:
         # 5. reap
         self._reap()
         self._expire_queued_deadlines()
-        if (self.telemetry is not None
-                and self._ticks % self.sample_every == 0):
+        # 5b. finalize scale-downs: a DRAINING replica with nothing
+        # resident closes and becomes a tombstone
+        self._finalize_removals()
+        if self._ticks % self.sample_every == 0:
             for r in self._replicas:
+                if self._status[r.index] == REMOVED:
+                    continue
                 h = self._health[r.index]
                 self.telemetry.replica(
                     tick=self._ticks, replica=r.index,
@@ -995,7 +1111,33 @@ class ReplicaRouter:
                     parked=h.get("parked", 0),
                     occupancy=round(h.get("occupancy", 0.0), 4),
                     progress=h.get("progress", -1))
+        self._feed_signals()
         return self._step_stats(dispatched)
+
+    def _feed_signals(self) -> None:
+        """One sample per autoscaler signal per tick, into the
+        telemetry rings — queue depth, mean healthy occupancy, fleet
+        TTFT EMA, per-tick submitted/shed deltas (windowed shed RATE is
+        computed ring-side), prefill backlog, healthy count."""
+        healthy = [self._health[i] for i, s in enumerate(self._status)
+                   if s == HEALTHY]
+        occ = (sum(h.get("occupancy", 0.0) for h in healthy)
+               / len(healthy)) if healthy else None
+        emas = [h.get("ttft_ema_s") for h in healthy]
+        emas = [e for e in emas if e]
+        backlog = len(self._queue) + sum(
+            h.get("prefilling", 0) + h.get("parked", 0) for h in healthy)
+        sub, shed = (self._stats["submitted"],
+                     self._stats["shed_requests"])
+        dsub = sub - self._last_signal_counts[0]
+        dshed = shed - self._last_signal_counts[1]
+        self._last_signal_counts = (sub, shed)
+        self.telemetry.signal(
+            queue_depth=len(self._queue), occupancy=occ,
+            ttft_ema_s=(sum(emas) / len(emas)) if emas else None,
+            submitted=dsub, shed=dshed, prefill_backlog=backlog,
+            healthy=sum(s == HEALTHY for s in self._status),
+            in_flight=self.in_flight)
 
     def _step_stats(self, dispatched: int) -> dict:
         return {"tick": self._ticks, "dispatched": dispatched,
@@ -1008,7 +1150,7 @@ class ReplicaRouter:
     def _check_health(self) -> None:
         for r in self._replicas:
             i = r.index
-            if self._status[i] == DEAD:
+            if self._status[i] in (DEAD, REMOVED):
                 continue
             try:
                 h = r.health()
@@ -1021,7 +1163,10 @@ class ReplicaRouter:
             if not h.get("alive", True):
                 self._declare_dead(r, "crashed")
                 continue
-            if self._status[i] == HEALTHY:
+            # DRAINING replicas keep the watchdog: a scale-down target
+            # that hangs mid-drain must still be shot (its streams fail
+            # over) instead of stranding them behind a tombstone-to-be
+            if self._status[i] in (HEALTHY, DRAINING):
                 self._occ_sum[i] += h.get("occupancy", 0.0)
                 self._occ_n[i] += 1
                 # hang watchdog: work assigned + watermark frozen for
@@ -1051,8 +1196,12 @@ class ReplicaRouter:
                         >= getattr(r, "hang_grace_s", 0.0)):
                     self._declare_dead(r, "hung")
                     continue
-                # periodic sick probe
-                if self._ticks % self.health_every == 0:
+                # periodic sick probe (HEALTHY only: a DRAINING replica
+                # is leaving regardless — quarantining it would erase
+                # the scale-down marker, and its streams are minutes
+                # from done; crash/hang detection still covers it)
+                if (self._status[i] == HEALTHY
+                        and self._ticks % self.health_every == 0):
                     try:
                         ok = r.probe()
                     except ReplicaCrashed:
@@ -1115,6 +1264,7 @@ class ReplicaRouter:
         now = time.perf_counter()
         for i, r in enumerate(self._replicas):
             if (self._status[i] != DEAD
+                    or i in self._retiring  # scale-down target: stay down
                     or self._respawns[i] >= self.respawn_budget
                     or now < self._respawn_eligible[i]):
                 continue
@@ -1208,11 +1358,15 @@ class ReplicaRouter:
     def _fleet_unrecoverable(self) -> bool:
         """All replicas DEAD *and* no respawn can ever bring one back —
         the only state where waiting on the router is hopeless."""
-        if any(s != DEAD for s in self._status):
+        if any(s not in (DEAD, REMOVED) for s in self._status):
             return False
+        if all(s == REMOVED for s in self._status):
+            return True   # fully scaled away: nothing respawns a tombstone
         if not self.respawn_budget:
             return True
-        return all(n >= self.respawn_budget for n in self._respawns)
+        return all(n >= self.respawn_budget or i in self._retiring
+                   for i, n in enumerate(self._respawns)
+                   if self._status[i] == DEAD)
 
     def _quarantine(self, r) -> None:
         """Sick (params non-finite): fail its streams over NOW — every
@@ -1254,6 +1408,211 @@ class ReplicaRouter:
         self._last_progress_t[r.index] = time.perf_counter()
         self._stats["rejoins"] += 1
         self._event("rejoin", replica=r.index)
+
+    # -- elastic scaling (ISSUE 15) ------------------------------------
+
+    def add_replica(self, role: str = ROLE_BOTH) -> int:
+        """Grow the fleet by one replica at a NEW index (tombstoned
+        indices are never reused — the per-replica parallel lists are
+        append-only, so every replica's counters and occupancy history
+        survive into the summary).
+
+        In-process replicas warm synchronously and join HEALTHY at
+        once: they share the fleet's jit cache, so warmup is a cache
+        hit — ZERO fresh compiles (the warm-join property the
+        flash-crowd test pins). Subprocess replicas launch under the
+        same spec/env contract as an ISSUE-10 respawn — checkpoint
+        restore + persistent AOT compile cache — warm ASYNCHRONOUSLY
+        and join through the quarantine -> clean-probe gauntlet,
+        exactly like a recovered crash."""
+        if role not in ROLES:
+            raise ValueError(
+                f"unknown role {role!r} (want one of {ROLES})")
+        i = len(self._replicas)
+        if self._worker_specs is not None:
+            spec = self._base_specs[i % len(self._base_specs)]
+            self._worker_specs.append(spec)
+            fresh = SubprocessReplica(
+                i, spec, world_size=i + 1, heartbeat_dir=self._hb_dir,
+                master_port=self._worker_port)
+        else:
+            fresh = InProcessReplica(i, self._factory_fn(i),
+                                     warmup_lens=self.warmup_lens)
+        self._replicas.append(fresh)
+        self._roles.append(role)
+        self._assigned.append({})
+        self._status.append(QUARANTINED)
+        self._last_progress.append(None)
+        self._last_progress_t.append(time.perf_counter())
+        self._stale.append(0)
+        self._clean_probes.append(0)
+        self._health.append({"alive": True, "progress": -1})
+        self._placements.append(0)
+        self._respawns.append(0)
+        self._respawn_eligible.append(0.0)
+        self._warming_deadline.append(0.0)
+        self._occ_sum.append(0.0)
+        self._occ_n.append(0)
+        self._disagg = any(x != ROLE_BOTH for x in self._roles)
+        if isinstance(fresh, SubprocessReplica):
+            fresh.warmup_async(self.warmup_lens)
+            self._warming_deadline[i] = (time.perf_counter()
+                                         + self.respawn_warmup_s)
+        else:
+            fresh.warmup(self.warmup_lens)
+            self._status[i] = HEALTHY
+            self._health[i] = fresh.health()
+        self._stats["scale_ups"] += 1
+        self._event("scale_up", replica=i, role=role,
+                    mode=("async" if isinstance(fresh, SubprocessReplica)
+                          else "warm"))
+        return i
+
+    def remove_replica(self, index: int | None = None,
+                       role: str | None = None) -> int | None:
+        """Begin a graceful scale-down: pick the least-loaded HEALTHY
+        replica (optionally a specific ``index``, optionally matching
+        ``role``), mark it DRAINING — it keeps stepping its resident
+        streams (and handing off parked prefills) but admits nothing
+        new, then closes into a REMOVED tombstone once empty. Returns
+        the chosen index, or None when nothing can be spared: never
+        the last healthy replica, and in a disaggregated fleet never
+        the last healthy prefill- or decode-capable one."""
+        healthy = [i for i, s in enumerate(self._status)
+                   if s == HEALTHY]
+
+        def sparable(i: int) -> bool:
+            rest = [j for j in healthy if j != i]
+            if not rest:
+                return False
+            if self._disagg:
+                for caps in ((ROLE_DECODE, ROLE_BOTH),
+                             (ROLE_PREFILL, ROLE_BOTH)):
+                    if (self._roles[i] in caps
+                            and not any(self._roles[j] in caps
+                                        for j in rest)):
+                        return False
+            return True
+
+        cands = [i for i in healthy
+                 if (index is None or i == index)
+                 and (role is None or self._roles[i] == role)
+                 and sparable(i)]
+        if not cands:
+            return None
+        # least resident work first; highest index breaks ties (LIFO
+        # scale-down pairs with append-only scale-up)
+        i = min(cands, key=lambda j: (
+            len(self._assigned[j]),
+            self._health[j].get("occupancy", 0.0), -j))
+        self._status[i] = DRAINING
+        self._retiring.add(i)
+        self._prefix_index.remove(i)
+        self._stats["scale_downs"] += 1
+        self._event("scale_down", replica=i, role=self._roles[i],
+                    resident=len(self._assigned[i]))
+        return i
+
+    def _finalize_removals(self) -> None:
+        for i, s in enumerate(self._status):
+            if s != DRAINING or self._assigned[i]:
+                continue
+            try:
+                self._replicas[i].close()
+            except Exception:  # noqa: BLE001 — the tombstone wins
+                pass
+            self._status[i] = REMOVED
+            self._event("replica_removed", replica=i)
+
+    def pool_state(self) -> dict[str, dict]:
+        """Aggregate per-pool capacity view (the autoscaler's scaling
+        input): one ``"fleet"`` pool colocated; separate ``"prefill"``
+        and ``"decode"`` pools when disaggregated (ROLE_BOTH counts
+        decode — it receives handoffs)."""
+        def agg(idxs):
+            idxs = list(idxs)
+            healthy = [i for i in idxs if self._status[i] == HEALTHY]
+            hs = [self._health[i] for i in healthy]
+            return {
+                "replicas": len(idxs),
+                "healthy": len(healthy),
+                "draining": sum(self._status[i] == DRAINING
+                                for i in idxs),
+                "quarantined": sum(self._status[i] == QUARANTINED
+                                   for i in idxs),
+                "dead": sum(self._status[i] == DEAD for i in idxs),
+                "removed": sum(self._status[i] == REMOVED
+                               for i in idxs),
+                "occupancy": (sum(h.get("occupancy", 0.0) for h in hs)
+                              / len(hs)) if hs else None,
+                "free_slots": sum(h.get("free_slots", 0) for h in hs),
+                "queued": sum(h.get("queued", 0) for h in hs),
+                "prefilling": sum(h.get("prefilling", 0) for h in hs),
+                "parked": sum(h.get("parked", 0) for h in hs),
+            }
+
+        if not self._disagg:
+            return {"fleet": agg(range(len(self._replicas)))}
+        return {
+            "prefill": agg(i for i, ro in enumerate(self._roles)
+                           if ro == ROLE_PREFILL),
+            "decode": agg(i for i, ro in enumerate(self._roles)
+                          if ro in (ROLE_DECODE, ROLE_BOTH)),
+        }
+
+    # -- admission-pressure preemption (ISSUE 15) ----------------------
+
+    def _maybe_preempt(self) -> None:
+        """When a COMPLIANT tenant's request heads the queue and the
+        fleet is saturated, evict the newest active stream of the
+        tenant furthest over its weight share — losslessly, over the
+        engine's preempt-requeue path (the evicted stream resumes from
+        its delivered tokens once capacity frees). Rate-limited to one
+        eviction per ``preempt_every`` ticks: preemption pays a
+        re-prefill, so it must relieve starvation, not thrash."""
+        if self._admission is None or self._draining:
+            return
+        if self._ticks - self._last_preempt_tick < self.preempt_every:
+            return
+        starved = self._queue.starved_head()
+        if starved is None:
+            return
+        # only under saturation: with room anywhere, plain dispatch
+        # serves the starved head next tick
+        for i, s in enumerate(self._status):
+            if s != HEALTHY:
+                continue
+            h = self._health[i]
+            load = (h.get("active", 0) + h.get("queued", 0)
+                    + h.get("prefilling", 0) + h.get("parked", 0))
+            if load < h.get("num_slots", 1) + self.max_pending:
+                return
+        over = self._queue.overages()
+        best = None
+        for i, s in enumerate(self._status):
+            if s != HEALTHY:
+                continue
+            for rr in self._assigned[i].values():
+                o = over.get(rr.tenant, 0.0)
+                if o <= 0 or rr.tenant == starved.tenant:
+                    continue
+                key = (o, rr.id)   # worst overage; newest stream
+                if best is None or key > best[0]:
+                    best = (key, rr, i)
+        if best is None:
+            return
+        _, rr, idx = best
+        try:
+            ok = self._replicas[idx].preempt(rr)
+        except (ReplicaCrashed, TimeoutError):
+            self._declare_dead(self._replicas[idx], "crashed")
+            return
+        if ok:
+            self._last_preempt_tick = self._ticks
+            self._stats["preemptions"] += 1
+            self._event("preempt", request=rr.id, tenant=rr.tenant,
+                        replica=idx, for_tenant=starved.tenant,
+                        tokens_so_far=len(rr.tokens))
 
     # -- failover ------------------------------------------------------
 
@@ -1471,6 +1830,15 @@ class ReplicaRouter:
             self._queue.appendleft(rr)
             self._declare_dead(r, "crashed")
             return False
+        except ValueError as e:
+            # the replica REFUSED the request (e.g. a per-request KV
+            # override its pool can't honor): terminal — every replica
+            # in a homogeneous fleet would refuse it the same way, so
+            # fail LOUDLY rather than redispatch-storm
+            self._finish(rr, "failed")
+            self._event("rejected", request=rr.id, replica=r.index,
+                        tenant=rr.tenant, error=str(e)[:200])
+            return True
         rr._handle = handle
         rr._replica = r.index
         rr.replicas.append(r.index)
@@ -1485,6 +1853,9 @@ class ReplicaRouter:
         if rr.done or rr._replica != replica:
             return  # stale delivery from a replaced placement
         rr.tokens.append(int(tok))
+        # each replica's first-ever delivery (the scale-up reaction
+        # clock's far edge: decision wall time -> this entry appearing)
+        self._first_token_t.setdefault(replica, time.perf_counter())
         if rr.first_token_time is None:
             rr.first_token_time = time.perf_counter()
         if rr.on_token is not None:
@@ -1508,6 +1879,20 @@ class ReplicaRouter:
             for rid in [rid for rid, rr in assigned.items()
                         if rr._handle is not None and rr._handle.done]:
                 rr = assigned.pop(rid)
+                if rr._handle.finish_reason == "preempted":
+                    # admission-pressure eviction: NOT a client-visible
+                    # finish — requeue immediately (no backoff: the
+                    # request did nothing wrong) and resume-from-tokens
+                    # replays it losslessly when capacity frees
+                    rr._handle = None
+                    rr._replica = None
+                    rr._eligible_at = 0.0
+                    self._queue.appendleft(rr)
+                    self._stats["preempted_requeues"] += 1
+                    self._event("preempt_requeue", request=rr.id,
+                                tenant=rr.tenant,
+                                tokens_so_far=len(rr.tokens))
+                    continue
                 self._finish(rr, rr._handle.finish_reason)
 
     # -- prefill→decode handoff (ISSUE 12) -----------------------------
@@ -1521,7 +1906,9 @@ class ReplicaRouter:
         if not self._disagg:
             return
         for src in self._replicas:
-            if (self._status[src.index] != HEALTHY
+            # DRAINING sources sweep too: a scale-down target's parked
+            # prefills must reach a decode home before the tombstone
+            if (self._status[src.index] not in (HEALTHY, DRAINING)
                     or self._roles[src.index] != ROLE_PREFILL):
                 continue
             parked = [rr for rr in self._assigned[src.index].values()
@@ -1655,8 +2042,16 @@ class ReplicaRouter:
                     self._stats["served_by"].get(rr._replica, 0) + 1
         if reason == "failed":
             self._stats["failed_requests"] += 1
+        t = self._tenant_stats(rr.tenant)
+        if reason in ("length", "stop", "deadline"):
+            t["completed"] += 1
+        elif reason == "shed":
+            t["shed"] += 1
+        elif reason == "failed":
+            t["failed"] += 1
         if rr.ttft_s is not None:
             self._stats["ttft_s"].append(rr.ttft_s)
+            t["ttft_s"].append(rr.ttft_s)
         for rec in self._recovering:
             rec["pending"].discard(rr.id)
         self._gc_recovering()
@@ -1742,9 +2137,10 @@ class ReplicaRouter:
             self._finish(rr, "drained")
             out.append(rr)
         while any(self._assigned[r.index] for r in self._replicas
-                  if self._status[r.index] == HEALTHY) and max_steps:
+                  if self._status[r.index] in (HEALTHY, DRAINING)) \
+                and max_steps:
             for r in self._replicas:
-                if self._status[r.index] != HEALTHY:
+                if self._status[r.index] not in (HEALTHY, DRAINING):
                     continue
                 try:
                     r.step()
@@ -1778,10 +2174,11 @@ class ReplicaRouter:
         escalation — no orphans), stamp the telemetry summary."""
         self.drain()
         subs = [r for r in self._replicas
-                if isinstance(r, SubprocessReplica)]
+                if isinstance(r, SubprocessReplica)
+                and self._status[r.index] != REMOVED]
         for r in self._replicas:
-            if r in subs:
-                continue
+            if r in subs or self._status[r.index] == REMOVED:
+                continue   # tombstones already closed at removal
             try:
                 r.close()
             except ReplicaCrashed:
@@ -1827,11 +2224,31 @@ class ReplicaRouter:
                            respawns=0, respawn_failures=0,
                            handoffs=0, handoff_failures=0,
                            prefix_ships=0, kv_stream_bytes=0,
+                           scale_ups=0, scale_downs=0,
+                           preemptions=0, preempted_requeues=0,
+                           tenants={},
                            served_by={}, ttft_s=[],
                            failover_recovery_ticks=[],
                            failover_recovery_s=[])
         self._occ_sum = [0.0 for _ in self._replicas]
         self._occ_n = [0 for _ in self._replicas]
+        self._first_token_t = {}
+        self._last_signal_counts = (0, 0)
+
+    def _tenant_stats(self, name: str) -> dict:
+        t = self._stats["tenants"].get(name)
+        if t is None:
+            t = self._stats["tenants"][name] = dict(
+                submitted=0, completed=0, shed=0, failed=0, ttft_s=[])
+        return t
+
+    @property
+    def first_token_times(self) -> dict[int, float]:
+        """Wall-clock time each replica delivered its FIRST token since
+        the last reset_stats — the far edge of the autoscaler's
+        scale-up reaction measurement (decision wall time -> the new
+        replica's entry appearing here)."""
+        return dict(self._first_token_t)
 
     @property
     def queue_depth(self) -> int:
@@ -1877,6 +2294,11 @@ class ReplicaRouter:
             "replicas_lost": st["replicas_lost"],
             "respawns": st["respawns"],
             "respawn_failures": st["respawn_failures"],
+            "scale_ups": st["scale_ups"],
+            "scale_downs": st["scale_downs"],
+            "preemptions": st["preemptions"],
+            "preempted_requeues": st["preempted_requeues"],
+            "statuses": list(self._status),
             "roles": list(self._roles),
             "handoffs": st["handoffs"],
             "handoff_failures": st["handoff_failures"],
@@ -1911,4 +2333,22 @@ class ReplicaRouter:
                 float(np.percentile(ttfts, 50)) * 1e3, 3)
             out["ttft_ms_p99"] = round(
                 float(np.percentile(ttfts, 99)) * 1e3, 3)
+        if st["tenants"]:
+            adm = (self._admission.tenant_stats()
+                   if self._admission is not None else {})
+            tens = {}
+            for name, t in sorted(st["tenants"].items()):
+                row = {k: t[k] for k in ("submitted", "completed",
+                                         "shed", "failed")}
+                ts = np.asarray(t["ttft_s"], np.float64)
+                if ts.size:
+                    row["ttft_ms_p50"] = round(
+                        float(np.percentile(ts, 50)) * 1e3, 3)
+                    row["ttft_ms_p99"] = round(
+                        float(np.percentile(ts, 99)) * 1e3, 3)
+                if name in adm:
+                    row["weight"] = adm[name]["weight"]
+                    row["overage"] = adm[name]["overage"]
+                tens[name] = row
+            out["tenants"] = tens
         return out

@@ -13,6 +13,7 @@ JsonlWriter (line-buffered append: rows survive a killed server).
 
 from __future__ import annotations
 
+import collections
 import os
 import time
 
@@ -105,6 +106,37 @@ class ServingTelemetry:
         return False
 
 
+class SignalRing:
+    """One bounded time series: an EMA plus the last-N raw samples.
+    Pure host state — the autoscaler's decision inputs, so everything
+    here must work without a run_dir or a wall clock."""
+
+    def __init__(self, maxlen: int = 256, alpha: float = 0.2):
+        self.samples: collections.deque[float] = collections.deque(
+            maxlen=maxlen)
+        self.alpha = alpha
+        self.ema: float | None = None
+        self.count = 0
+
+    def push(self, value: float) -> None:
+        v = float(value)
+        self.samples.append(v)
+        self.ema = (v if self.ema is None
+                    else (1 - self.alpha) * self.ema + self.alpha * v)
+        self.count += 1
+
+    def stats(self, window: int | None = None) -> dict:
+        xs = list(self.samples)
+        if window is not None:
+            xs = xs[-window:]
+        if not xs:
+            return {"last": None, "ema": None, "n": 0,
+                    "sum": 0.0, "mean": None, "max": None}
+        return {"last": xs[-1], "ema": self.ema, "n": len(xs),
+                "sum": float(sum(xs)), "mean": float(sum(xs) / len(xs)),
+                "max": float(max(xs))}
+
+
 class RouterTelemetry:
     """The replica router's metric sink (ISSUE 9) — one JSONL stream per
     router under ``router_metrics_rank{rank}.jsonl``, next to the
@@ -114,41 +146,86 @@ class RouterTelemetry:
         active, queued, parked KV handoffs, occupancy, progress
         watermark) at the router's sampling cadence;
       * ``event``   — one lifecycle transition (failover, redispatch,
-        shed, quarantine, rejoin, drain) with its router tick: the
-        post-mortem trail of WHY streams moved between replicas;
+        shed, quarantine, rejoin, drain, scale_up/scale_down) with its
+        router tick: the post-mortem trail of WHY streams moved
+        between replicas — and WHY the fleet grew or shrank;
       * ``router``  — the close-time summary (failovers,
         redispatched_requests, shed_requests, quarantines, rejoins,
-        per-replica occupancy balance) the report CLI's router table
-        renders.
+        per-replica occupancy balance, per-tenant table) the report
+        CLI's router table renders.
+
+    ISSUE 15 adds the in-memory half the autoscaler consumes: every
+    ``signal()`` call lands in a bounded per-signal ring (EMA + last-N
+    samples; ``snapshot()`` reads them), and ``run_dir=None``
+    constructs a RING-ONLY instance — no directory, no JSONL, just the
+    live time series — so a router always has signals to offer even
+    when nobody asked for files.
     """
 
-    def __init__(self, run_dir: str | os.PathLike, rank: int | None = None):
-        self.run_dir = str(run_dir)
-        os.makedirs(self.run_dir, exist_ok=True)
+    def __init__(self, run_dir: str | os.PathLike | None = None,
+                 rank: int | None = None, *, ring: int = 256,
+                 ema_alpha: float = 0.2):
+        self.run_dir = None if run_dir is None else str(run_dir)
         self.rank = (rank if rank is not None
                      else int(os.environ.get("RANK", "0")))
-        self.metrics = JsonlWriter(os.path.join(
-            self.run_dir, ROUTER_METRICS_FILE.format(rank=self.rank)))
+        if self.run_dir is None:
+            self.metrics = None
+        else:
+            os.makedirs(self.run_dir, exist_ok=True)
+            self.metrics = JsonlWriter(os.path.join(
+                self.run_dir, ROUTER_METRICS_FILE.format(rank=self.rank)))
+        self._ring_len = ring
+        self._ema_alpha = ema_alpha
+        self.rings: dict[str, SignalRing] = {}
+        self.recent_events: collections.deque[dict] = collections.deque(
+            maxlen=ring)
 
     @classmethod
     def from_env(cls) -> "RouterTelemetry | None":
         d = os.environ.get(TELEMETRY_DIR_ENV)
         return cls(d) if d else None
 
+    def signal(self, **signals) -> None:
+        """Feed one sample per named signal into its ring (creating
+        rings on first sight). None values are skipped — a signal with
+        no reading this tick simply has no sample."""
+        for name, value in signals.items():
+            if value is None:
+                continue
+            ring = self.rings.get(name)
+            if ring is None:
+                ring = self.rings[name] = SignalRing(
+                    maxlen=self._ring_len, alpha=self._ema_alpha)
+            ring.push(value)
+
+    def snapshot(self, window: int | None = None) -> dict[str, dict]:
+        """Per-signal {last, ema, n, sum, mean, max} over the ring (or
+        its last ``window`` samples) — the autoscaler's whole view of
+        the world, and the metric snapshot its decisions are stamped
+        with."""
+        return {name: ring.stats(window)
+                for name, ring in sorted(self.rings.items())}
+
     def replica(self, **row) -> None:
-        self.metrics.write({"kind": "replica",
-                            "time": round(time.time(), 3), **row})
+        if self.metrics is not None:
+            self.metrics.write({"kind": "replica",
+                                "time": round(time.time(), 3), **row})
 
     def event(self, event: str, **row) -> None:
-        self.metrics.write({"kind": "event", "event": event,
-                            "time": round(time.time(), 3), **row})
+        self.recent_events.append({"event": event, "time": time.time(),
+                                   **row})
+        if self.metrics is not None:
+            self.metrics.write({"kind": "event", "event": event,
+                                "time": round(time.time(), 3), **row})
 
     def summary(self, **row) -> None:
-        self.metrics.write({"kind": "router",
-                            "time": round(time.time(), 3), **row})
+        if self.metrics is not None:
+            self.metrics.write({"kind": "router",
+                                "time": round(time.time(), 3), **row})
 
     def close(self) -> None:
-        self.metrics.close()
+        if self.metrics is not None:
+            self.metrics.close()
 
     def __enter__(self):
         return self

@@ -356,6 +356,49 @@ def _router_section(run_dir: str) -> list[str]:
                 f"  {i:>7}  {role:>7}  {status:>11}  {served.get(i, 0):>6}  "
                 f"{(f'{o:.2%}' if o is not None else '-'):>9}  "
                 f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}  {hoff:>8}")
+        tens = (summary or {}).get("tenants") or {}
+        if tens:
+            # the multi-tenant admission table (ISSUE 15): per-tenant
+            # request accounting plus the WDRR weight and the signed
+            # token overage the scheduler held it to (positive = served
+            # beyond its weighted fair share; sheds land there first)
+            lines.append(
+                f"  {'tenant':>10}  {'submitted':>9}  {'completed':>9}  "
+                f"{'shed':>5}  {'ttft_p99':>10}  {'weight':>6}  "
+                f"{'overage':>8}")
+            for name, t in sorted(tens.items()):
+                p99 = t.get("ttft_ms_p99")
+                wt, ov = t.get("weight"), t.get("overage")
+                p99_s = f"{p99:.1f} ms" if p99 is not None else "-"
+                wt_s = f"{wt:g}" if wt is not None else "-"
+                ov_s = f"{ov:+.2f}" if ov is not None else "-"
+                lines.append(
+                    f"  {name:>10}  {t.get('submitted', 0):>9}  "
+                    f"{t.get('completed', 0):>9}  {t.get('shed', 0):>5}  "
+                    f"{p99_s:>10}  {wt_s:>6}  {ov_s:>8}")
+        # the scaling timeline (ISSUE 15): autoscale_* rows are the
+        # control loop's decisions (stamped with the breach that
+        # justified them), scale_* the router acting on them (or an
+        # operator's manual add/remove) — relative seconds from the
+        # first event, so a flash crowd reads as a burst
+        scaling = [e for e in events
+                   if e.get("event") in ("autoscale_up", "autoscale_down",
+                                         "scale_up", "scale_down")]
+        if scaling:
+            t0 = scaling[0].get("time", 0.0)
+            lines.append("  scaling timeline:")
+            for e in scaling:
+                why = e.get("why")
+                q = e.get("queue_depth")
+                detail = f"  why={why}" if why else ""
+                if q is not None:
+                    detail += f"  queue={q:g}"
+                if e.get("mode"):
+                    detail += f"  mode={e['mode']}"
+                lines.append(
+                    f"    +{e.get('time', t0) - t0:6.2f}s  "
+                    f"{e.get('event', '-'):<14}  "
+                    f"replica {e.get('replica', '-')}{detail}")
     return lines
 
 

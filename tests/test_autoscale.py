@@ -1,0 +1,803 @@
+"""SLO-aware autoscaling + multi-tenant admission (ISSUE 15).
+
+Three layers, cheapest first:
+
+  * PURE HOST — the traffic generators (seeded determinism tripwire,
+    shape/tenant-mix properties), the AdmissionController's WDRR
+    fairness (the acceptance pin: a hot tenant at 10x its budget CANNOT
+    push a compliant tenant's shed count above zero), priority tiers,
+    rate buckets on a FakeClock, pressure->window clamping, and the
+    Autoscaler decision machine against a stub router (hysteresis,
+    cooldowns, bounds, role-aware disagg pools) — no jax anywhere.
+  * IN-PROCESS JAX — elastic add/remove on a live router (tombstone
+    history surviving removal), the closed-loop flash-crowd demo
+    (seeded trace -> queue growth -> warm scale-up with ZERO fresh
+    compiles on the joiner -> drain back to baseline, compliant tenant
+    shed == 0 throughout), router-level lossless preemption under
+    tenant pressure, per-request KV window overrides (bitwise vs a
+    natively tighter pool) and the loud rejection walls. Engine
+    geometry mirrors tests/test_router.py / test_paging.py so the
+    compiled programs ride the suite's shared jit cache.
+  * SUBPROCESS (full tier only) — the autoscale e2e over real
+    run.py-env-contract workers: flash crowd, async warm join through
+    quarantine, graceful drain-down, zero orphan processes.
+
+No wall-clock sleeps in the quick tier: every clock is a FakeClock.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import (
+    AdmissionController,
+    Autoscaler,
+    FakeClock,
+    ReplicaRouter,
+    RouterTelemetry,
+    ServingEngine,
+    SignalRing,
+    SLOConfig,
+    TenantConfig,
+    TenantTraffic,
+    make_trace,
+    replay,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.engine import (
+    decode_tick,
+    prefill_into_slot,
+)
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    return model, params, dm
+
+
+def _ref(prompt, n):
+    _, params, dm = _setup()
+    return np.asarray(generate(dm, params, jnp.asarray(prompt)[None],
+                               max_new_tokens=n))[0]
+
+
+class _Req:
+    """The slice of RouterRequest the admission controller reads."""
+
+    _ids = iter(range(1, 10**9))
+
+    def __init__(self, tenant, cost=10, priority=0, kv_window=None):
+        self.id = next(self._ids)
+        self.tenant = tenant
+        self.priority = priority
+        self.prompt = np.zeros(cost // 2, np.int32)
+        self.max_new_tokens = cost - cost // 2
+        self.kv_window = kv_window
+
+
+# ----------------------------------------------------------------------
+# traffic generators (pure host)
+
+def test_traffic_determinism_and_validation():
+    """The determinism tripwire: same seed -> byte-identical trace,
+    prompts included. Plus the validation walls and FakeClock basics."""
+    tens = (TenantTraffic("a", share=3, prefix_len=6, prefix_frac=0.5),
+            TenantTraffic("b", share=1, priority=1))
+    kw = dict(seed=11, duration_s=20.0, base_qps=4.0, shape="flash",
+              peak_mult=5.0, tenants=tens)
+    t1, t2 = make_trace(**kw), make_trace(**kw)
+    assert len(t1) == len(t2) > 20
+    for a, b in zip(t1, t2):
+        assert (a.at_s, a.tenant, a.priority, a.max_new_tokens) == \
+            (b.at_s, b.tenant, b.priority, b.max_new_tokens)
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+    t3 = make_trace(**{**kw, "seed": 12})
+    assert [r.at_s for r in t3] != [r.at_s for r in t1]
+    with pytest.raises(ValueError, match="unknown traffic shape"):
+        make_trace(seed=0, duration_s=1, base_qps=1, shape="bursty")
+    with pytest.raises(ValueError, match="must be > 0"):
+        make_trace(seed=0, duration_s=0, base_qps=1)
+    clk = FakeClock(5.0)
+    clk.advance(2.5)
+    assert clk() == clk.now() == 7.5
+    with pytest.raises(ValueError, match="forward"):
+        clk.advance(-1)
+
+
+def test_traffic_shapes_tenant_mix_and_prefixes():
+    """Flash window runs ~peak_mult x the background rate; tenant
+    shares land near the mix; a prefix_frac=1 tenant always opens with
+    its fixed prefix; lengths respect the caps."""
+    tens = (TenantTraffic("hot", share=3, prefix_len=8, prefix_frac=1.0),
+            TenantTraffic("cold", share=1, priority=2))
+    trace = make_trace(seed=3, duration_s=60.0, base_qps=6.0,
+                       shape="flash", peak_mult=4.0, flash_at_s=20.0,
+                       flash_len_s=10.0, tenants=tens, prompt_cap=24,
+                       new_cap=12)
+    in_flash = [r for r in trace if 20.0 <= r.at_s < 30.0]
+    outside = [r for r in trace if not 20.0 <= r.at_s < 30.0]
+    flash_qps = len(in_flash) / 10.0
+    base_qps = len(outside) / 50.0
+    assert flash_qps > 2.5 * base_qps, (flash_qps, base_qps)
+    hot = [r for r in trace if r.tenant == "hot"]
+    cold = [r for r in trace if r.tenant == "cold"]
+    assert len(hot) + len(cold) == len(trace)
+    assert 1.8 < len(hot) / max(1, len(cold)) < 5.0
+    pre = hot[0].prompt[:8]
+    for r in hot:
+        np.testing.assert_array_equal(r.prompt[:8], pre)
+        assert r.priority == 0
+    for r in cold:
+        assert r.priority == 2
+    for r in trace:
+        assert 1 <= r.prompt.size <= 24 and 1 <= r.max_new_tokens <= 12
+    steady = make_trace(seed=3, duration_s=60.0, base_qps=6.0)
+    assert all(r.tenant == "default" for r in steady)
+
+
+# ----------------------------------------------------------------------
+# admission control (pure host)
+
+def test_wdrr_weighted_token_fairness_and_priority_tiers():
+    """Served token cost tracks WDRR weights (3:1), not request counts;
+    a lower priority tier is never popped while a higher one queues."""
+    ac = AdmissionController({"big": TenantConfig(weight=3.0),
+                              "small": TenantConfig(weight=1.0)})
+    for _ in range(60):
+        assert ac.offer(_Req("big", cost=20)) is None
+        assert ac.offer(_Req("small", cost=20)) is None
+    served = {"big": 0.0, "small": 0.0}
+    for _ in range(80):
+        rr = ac.popleft()
+        served[rr.tenant] += rr.prompt.size + rr.max_new_tokens
+    ratio = served["big"] / served["small"]
+    assert 2.0 < ratio < 4.5, served
+    # strict priority tiers above fairness
+    ac2 = AdmissionController({"fg": TenantConfig(), "bg": TenantConfig()})
+    for _ in range(5):
+        ac2.offer(_Req("bg", priority=1))
+    for _ in range(3):
+        ac2.offer(_Req("fg", priority=0))
+    order = [ac2.popleft().tenant for _ in range(8)]
+    assert order[:3] == ["fg"] * 3 and order[3:] == ["bg"] * 5
+    with pytest.raises(IndexError):
+        ac2.popleft()
+
+
+def test_admission_per_tenant_caps_and_rate_bucket():
+    """max_queued sheds the arrival itself; the token rate bucket
+    refills on the injected clock — no wall-clock anywhere."""
+    clk = FakeClock()
+    ac = AdmissionController(
+        {"capped": TenantConfig(max_queued=2),
+         "metered": TenantConfig(rate_tokens_per_s=10.0, burst_s=1.0)},
+        clock=clk)
+    a, b, c = _Req("capped"), _Req("capped"), _Req("capped")
+    assert ac.offer(a) is None and ac.offer(b) is None
+    assert ac.offer(c) is c          # over the per-tenant cap
+    # bucket starts at rate*burst = 10 tokens: one cost-10 fits
+    m1, m2 = _Req("metered", cost=10), _Req("metered", cost=10)
+    assert ac.offer(m1) is None
+    assert ac.offer(m2) is m2        # bucket empty, clock frozen
+    clk.advance(1.0)                 # +10 tokens
+    assert ac.offer(_Req("metered", cost=10)) is None
+    with pytest.raises(ValueError, match="weight"):
+        TenantConfig(weight=0)
+    with pytest.raises(ValueError, match="max_queued"):
+        TenantConfig(max_queued=0)
+    with pytest.raises(ValueError, match="rate_tokens_per_s"):
+        TenantConfig(rate_tokens_per_s=-1)
+
+
+def test_hot_tenant_at_10x_cannot_shed_compliant_tenant():
+    """THE fairness acceptance pin: with the global queue capped and a
+    hot tenant flooding at 10x a compliant neighbour's volume, every
+    shed lands on the hot tenant — the compliant tenant's shed count is
+    exactly zero, and it keeps being served."""
+    ac = AdmissionController({"hot": TenantConfig(weight=1.0),
+                              "calm": TenantConfig(weight=1.0)},
+                             max_queue=8)
+    shed = {"hot": 0, "calm": 0}
+    calm_admitted = 0
+    for i in range(200):
+        victim = ac.offer(_Req("hot", cost=20))
+        if victim is not None:
+            shed[victim.tenant] += 1
+        if i % 10 == 0:
+            rr = _Req("calm", cost=20)
+            victim = ac.offer(rr)
+            if victim is not None:   # a hot eviction = calm admitted
+                shed[victim.tenant] += 1
+            if victim is not rr:
+                calm_admitted += 1
+        if i % 4 == 0 and len(ac):
+            ac.popleft()
+    assert shed["calm"] == 0, shed
+    assert shed["hot"] > 0, shed
+    assert calm_admitted == 20
+    stats = ac.tenant_stats()
+    assert stats["hot"]["overage"] > 0 >= stats["calm"]["overage"]
+    # starved_head surfaces the compliant head, never the hot one
+    ac.offer(_Req("calm", cost=20))
+    head = ac.starved_head()
+    assert head is not None and head.tenant == "calm"
+
+
+def test_pressure_clamps_kv_windows_by_priority():
+    """Past pressure_depth, an admitted request's kv_window is clamped
+    to its priority class budget — tighten-only, best tier untouched."""
+    ac = AdmissionController(max_queue=None, pressure_depth=2,
+                             priority_windows={1: 8, 2: 4})
+    r0 = _Req("t", priority=1)
+    assert ac.offer(r0) is None and r0.kv_window is None  # no pressure
+    ac.offer(_Req("t"))
+    hi = _Req("t", priority=0)
+    lo = _Req("t", priority=1)
+    bg = _Req("t", priority=2, kv_window=2)
+    for rr in (hi, lo, bg):
+        assert ac.offer(rr) is None
+    assert hi.kv_window is None       # priority 0 has no budget entry
+    assert lo.kv_window == 8
+    assert bg.kv_window == 2          # already tighter: not loosened
+
+
+def test_admission_deque_protocol_roundtrip():
+    """append/appendleft/remove/len/iter keep the router's existing
+    queue idioms working; appendleft (requeue) never re-charges."""
+    ac = AdmissionController({"t": TenantConfig()})
+    rs = [_Req("t") for _ in range(3)]
+    for rr in rs:
+        assert ac.offer(rr) is None
+    charged = ac.tenant_stats()["t"]["charged_tokens"]
+    head = ac.popleft()
+    ac.appendleft(head)               # failover-style requeue
+    assert ac.tenant_stats()["t"]["charged_tokens"] == charged
+    assert len(ac) == 3 and bool(ac)
+    assert ac.popleft() is head       # back at the front
+    ac.remove(rs[2])
+    assert [r.id for r in ac] == [rs[1].id]
+    with pytest.raises(ValueError, match="not queued"):
+        ac.remove(rs[2])
+
+
+# ----------------------------------------------------------------------
+# the autoscaler decision machine (pure host, stub router)
+
+class _StubRouter:
+    """The narrow surface Autoscaler consumes, scriptable per tick."""
+
+    def __init__(self, pools=("fleet",), healthy=1):
+        self.telemetry = RouterTelemetry(None)
+        self.pools = {p: dict(replicas=healthy, healthy=healthy,
+                              draining=0, quarantined=0, dead=0,
+                              removed=0, occupancy=0.1, free_slots=3,
+                              queued=0, prefilling=0, parked=0)
+                      for p in pools}
+        self.added: list[str] = []
+        self.removed: list[str | None] = []
+        self.veto_remove = False
+        self.first_token_times: dict[int, float] = {}
+        self._next = healthy * len(self.pools)
+
+    def pool_state(self):
+        return {p: dict(st) for p, st in self.pools.items()}
+
+    def _pool_of(self, role):
+        if "fleet" in self.pools:
+            return "fleet"
+        return "decode" if role in ("decode", "both") else "prefill"
+
+    def add_replica(self, role="both"):
+        self.added.append(role)
+        self.pools[self._pool_of(role)]["healthy"] += 1
+        self._next += 1
+        return self._next - 1
+
+    def remove_replica(self, index=None, role=None):
+        if self.veto_remove:
+            return None
+        self.removed.append(role)
+        pool = self._pool_of(role or "both")
+        self.pools[pool]["healthy"] -= 1
+        return 0
+
+
+def test_autoscaler_hysteresis_cooldown_and_bounds():
+    clk = FakeClock()
+    stub = _StubRouter()
+    asc = Autoscaler(stub, SLOConfig(queue_high=4.0), min_replicas=1,
+                     max_replicas=3, breach_ticks=2, clear_ticks=3,
+                     up_cooldown_s=1.0, down_cooldown_s=1.0, clock=clk)
+    stub.telemetry.signal(queue_depth=20, submitted=5, shed=0)
+    assert asc.step() == []                   # breach 1 < breach_ticks
+    made = asc.step()                         # breach 2 -> scale up
+    assert [d["action"] for d in made] == ["scale_up"]
+    assert made[0]["why"] == ["queue_depth"]
+    assert made[0]["m_queue_depth"] > 4.0     # the justifying snapshot
+    assert stub.added == ["both"]
+    for _ in range(4):                        # still breaching, cooling
+        assert asc.step() == []
+    clk.advance(1.5)
+    asc.step(), asc.step()
+    assert len(stub.added) == 2 and stub.pools["fleet"]["healthy"] == 3
+    clk.advance(1.5)
+    for _ in range(5):                        # at max_replicas: capped
+        asc.step()
+    assert len(stub.added) == 2
+    # quarantined joiners count toward the bound
+    stub.pools["fleet"]["healthy"], stub.pools["fleet"]["quarantined"] = 2, 1
+    clk.advance(1.5)
+    for _ in range(3):
+        assert asc.step() == []
+    stub.pools["fleet"]["quarantined"] = 0
+    stub.pools["fleet"]["healthy"] = 3
+    # idle -> clear_ticks -> one graceful scale-down at a time
+    stub.telemetry.signal(queue_depth=0, submitted=0, shed=0)
+    stub.pools["fleet"]["occupancy"] = 0.05
+    for _ in range(40):                       # drain the queue EMA
+        stub.telemetry.signal(queue_depth=0, submitted=0, shed=0)
+    clk.advance(5.0)
+    downs = []
+    for _ in range(3):
+        downs += asc.step()
+    assert [d["action"] for d in downs] == ["scale_down"]
+    assert downs[0]["why"] == ["idle"]
+    # a draining pool blocks further shrink; a vetoed remove is no-op
+    stub.pools["fleet"]["draining"] = 1
+    clk.advance(5.0)
+    for _ in range(5):
+        assert asc.step() == []
+    stub.pools["fleet"]["draining"] = 0
+    stub.veto_remove = True
+    for _ in range(5):
+        assert asc.step() == []
+    assert stub.pools["fleet"]["healthy"] == 2
+    s = asc.summary()
+    assert s["scale_ups"] == 2 and s["scale_downs"] == 1
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(stub, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        Autoscaler(stub, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="occupancy_low"):
+        SLOConfig(occupancy_low=0.9, occupancy_high=0.5)
+
+
+def test_autoscaler_role_aware_disagg_pools():
+    """In a disaggregated fleet the pools scale INDEPENDENTLY: prefill
+    backlog grows only the prefill pool, decode occupancy only the
+    decode pool, each within its own pool_bounds."""
+    clk = FakeClock()
+    stub = _StubRouter(pools=("prefill", "decode"), healthy=1)
+    asc = Autoscaler(stub, SLOConfig(prefill_backlog_high=4.0,
+                                     occupancy_high=0.8, queue_high=50.0),
+                     pool_bounds={"prefill": (1, 2), "decode": (1, 3)},
+                     breach_ticks=2, clear_ticks=100,
+                     up_cooldown_s=0.0, clock=clk)
+    stub.telemetry.signal(prefill_backlog=10, queue_depth=0,
+                          submitted=4, shed=0)
+    asc.step()
+    made = asc.step()
+    assert [(d["action"], d["pool"]) for d in made] == \
+        [("scale_up", "prefill")]
+    assert made[0]["why"] == ["prefill_backlog"]
+    assert stub.added == ["prefill"]
+    # decode pressure scales decode only; prefill is now at its cap
+    stub.pools["decode"]["occupancy"] = 0.95
+    clk.advance(1.0)
+    for _ in range(3):
+        made += asc.step()
+    assert stub.added == ["prefill", "decode"]
+    ups = [(d["pool"], d["why"]) for d in made if d["action"] == "scale_up"]
+    assert ("decode", ["occupancy"]) in ups
+    # reaction_times joins decisions against first_token_times
+    up = [d for d in made if d["pool"] == "decode"][0]
+    stub.first_token_times[up["replica"]] = up["wall_t"] + 0.25
+    reacts = {r["replica"]: r["reaction_s"] for r in asc.reaction_times()}
+    assert abs(reacts[up["replica"]] - 0.25) < 1e-6
+
+
+def test_signal_ring_bounded_stats_and_snapshot():
+    ring = SignalRing(maxlen=4, alpha=0.5)
+    for v in range(10):
+        ring.push(float(v))
+    st = ring.stats()
+    assert st["n"] == 4 and st["last"] == 9.0 and st["max"] == 9.0
+    assert st["sum"] == 6.0 + 7 + 8 + 9 and st["mean"] == 7.5
+    assert 0 < st["ema"] < 9.0
+    tel = RouterTelemetry(None)           # ring-only mode: no files
+    tel.signal(queue_depth=3, shed=1, skipped=None)
+    tel.signal(queue_depth=5, shed=0)
+    snap = tel.snapshot()
+    assert set(snap) == {"queue_depth", "shed"}
+    assert snap["queue_depth"]["last"] == 5.0
+    assert snap["shed"]["sum"] == 1.0
+    tel.event("autoscale_up", pool="fleet")
+    assert tel.recent_events[-1]["event"] == "autoscale_up"
+
+
+# ----------------------------------------------------------------------
+# in-process jax: elastic scaling on a live router
+
+def _router(*, replicas=1, **kw):
+    model, params, _ = _setup()
+    router = ReplicaRouter(model, params, replicas=replicas,
+                           engine_kwargs=dict(num_slots=3,
+                                              prefill_bucket=16),
+                           warmup_lens=(16, 32), **kw)
+    router.warmup()
+    return router
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+            for m in (5, 9, 7, 11, 6, 8, 4, 10)[:n]]
+
+
+def test_router_add_remove_replica_tombstone_history():
+    """add_replica warm-joins at a NEW index (in-process: shares the
+    jit cache, HEALTHY immediately); remove_replica drains gracefully
+    to a REMOVED tombstone that is never renumbered — counters, roles
+    and served_by history survive the removal in summary()."""
+    router = _router(replicas=1)
+    try:
+        prompts = _prompts(4)
+        for p in prompts[:2]:
+            router.submit(p, max_new_tokens=5)
+        router.run_until_idle()
+        j = router.add_replica()
+        assert j == 1
+        st = router.pool_state()["fleet"]
+        assert st["healthy"] == 2 and st["draining"] == 0
+        # steer work onto the joiner so its history is non-trivial
+        reqs = [router.submit(p, max_new_tokens=5) for p in prompts]
+        router.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(
+                r.output_ids, _ref(p, 5), err_msg=f"request {r.id}")
+        assert router.remove_replica(index=j) == j
+        for _ in range(50):
+            router.step()
+            if router.summary()["statuses"][j] == "removed":
+                break
+        s = router.summary()
+        assert s["statuses"] == ["healthy", "removed"]
+        assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+        assert s["replicas"] == 2 and s["healthy_replicas"] == 1
+        # history survives the tombstone: the removed replica's serves
+        # stay in served_by, and the remove is vetoed at min fleet
+        assert sum(s["served_by"].values()) == s["completed"]
+        assert router.remove_replica() is None
+        evs = [e["event"] for e in router.telemetry.recent_events]
+        assert {"scale_up", "scale_down", "replica_removed"} <= set(evs)
+        # post-removal service still works on the survivor
+        r = router.submit(prompts[0], max_new_tokens=5)
+        router.run_until_idle()
+        np.testing.assert_array_equal(r.output_ids, _ref(prompts[0], 5))
+    finally:
+        router.close()
+
+
+def test_flash_crowd_autoscales_warm_and_drains_back():
+    """The closed-loop acceptance demo: a seeded flash crowd over a
+    hot(10x)/calm tenant mix on a 1-replica fleet. The autoscaler must
+    scale up on the breach WITHOUT a single fresh XLA trace (the warm
+    join shares the jit cache), the compliant tenant must shed exactly
+    zero while the queue cap sheds the hot tenant, and after the crowd
+    passes the fleet must drain back to baseline tombstones. Fully
+    deterministic arrivals (seeded trace + FakeClock), no sleeps."""
+    trace = make_trace(
+        seed=7, duration_s=4.0, base_qps=5.0, shape="flash",
+        peak_mult=30.0, flash_at_s=1.0, flash_len_s=1.5,
+        tenants=(TenantTraffic("hot", share=10.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=CFG.vocab_size, prompt_cap=24, new_cap=8)
+    assert {r.tenant for r in trace} == {"hot", "calm"}
+    router = _router(
+        replicas=1, max_queue=8,
+        tenants={"hot": TenantConfig(weight=1.0),
+                 "calm": TenantConfig(weight=1.0)})
+    clk = FakeClock()
+    # ttft_target is wall-clock — neutralized here (CPU step timing is
+    # not a test input); queue depth is the deterministic breach signal
+    asc = Autoscaler(router, SLOConfig(queue_high=3.0,
+                                       occupancy_high=0.9,
+                                       occupancy_low=0.5,
+                                       shed_rate_max=1.0,
+                                       ttft_target_ms=1e9),
+                     min_replicas=1, max_replicas=3, breach_ticks=2,
+                     clear_ticks=25, up_cooldown_s=0.3,
+                     down_cooldown_s=0.2, clock=clk)
+    try:
+        traces = dict(serving_engine.TRACE_COUNTS)
+        sizes = (decode_tick._cache_size(),
+                 prefill_into_slot._cache_size())
+        replay(router, trace, clock=clk, tick_s=0.02, autoscaler=asc)
+        # scaled up during the crowd...
+        s = router.summary()
+        assert s["scale_ups"] >= 1, s
+        # ...with ZERO fresh compiles anywhere (warm join = shared cache)
+        assert dict(serving_engine.TRACE_COUNTS) == traces
+        assert (decode_tick._cache_size(),
+                prefill_into_slot._cache_size()) == sizes
+        # fairness held under the cap: the hot tenant shed, calm did not
+        tens = s["tenants"]
+        assert s["shed_requests"] > 0, s
+        assert tens["calm"]["shed"] == 0, tens
+        assert tens["hot"]["shed"] == s["shed_requests"], tens
+        assert tens["hot"]["submitted"] > 4 * tens["calm"]["submitted"]
+        assert tens["calm"]["completed"] == tens["calm"]["submitted"]
+        assert s["completed"] == s["submitted"] - s["shed_requests"]
+        # keep ticking the idle fleet: it must drain back to baseline
+        for _ in range(3000):
+            router.step()
+            asc.step()
+            clk.advance(0.02)
+            if router.pool_state()["fleet"]["healthy"] == 1 \
+                    and router.pool_state()["fleet"]["draining"] == 0:
+                break
+        s = router.summary()
+        assert s["healthy_replicas"] == 1, s
+        assert s["scale_downs"] >= 1, s
+        assert all(st in ("healthy", "removed") for st in s["statuses"])
+        # every decision carries its justifying metric snapshot
+        for d in asc.decisions:
+            assert d["why"] and "m_queue_depth" in d
+        up_events = [e for e in router.telemetry.recent_events
+                     if e["event"] == "autoscale_up"]
+        assert up_events and "why" in up_events[0]
+        # the joiners actually served: reaction times are measurable
+        reacts = [r for r in asc.reaction_times()
+                  if r["reaction_s"] is not None]
+        assert reacts, asc.reaction_times()
+    finally:
+        router.close()
+
+
+def test_router_preempts_over_budget_tenant_losslessly():
+    """Admission-pressure preemption: a hot tenant saturating the only
+    replica gets one stream preempted (requeued, NOT dropped) when a
+    compliant tenant's request starves at the head — and every stream,
+    preempted included, still finishes bitwise-identical to the
+    uncontended reference."""
+    router = _router(replicas=1, preempt_every=2,
+                     tenants={"hot": TenantConfig(weight=1.0),
+                              "calm": TenantConfig(weight=1.0)})
+    try:
+        prompts = _prompts(7, seed=5)
+        hot = [router.submit(p, max_new_tokens=10, tenant="hot")
+               for p in prompts[:6]]
+        for _ in range(3):             # saturate: 3 slots + 1 pending
+            router.step()
+        calm = router.submit(prompts[6], max_new_tokens=10, tenant="calm")
+        router.run_until_idle()
+        s = router.summary()
+        assert s["preemptions"] >= 1, s
+        assert s["preempted_requeues"] == s["preemptions"]
+        assert s["completed"] == 7 and s["shed_requests"] == 0
+        for p, r in zip(prompts, hot + [calm]):
+            np.testing.assert_array_equal(
+                r.output_ids, _ref(p, 10), err_msg=f"request {r.id}")
+        evs = [e["event"] for e in router.telemetry.recent_events]
+        assert "preempt" in evs and "preempt_requeue" in evs
+    finally:
+        router.close()
+
+
+def test_router_rejects_incompatible_kv_override_loudly():
+    """A per-request window override on a pool that can't honor it
+    (dense engine) fails THAT request loudly — finish_reason "failed"
+    plus a "rejected" telemetry event — and never poisons the fleet."""
+    router = _router(replicas=1)
+    try:
+        with pytest.raises(ValueError, match="kv_window"):
+            router.submit(_prompts(1)[0], max_new_tokens=4, kv_window=0)
+        bad = router.submit(_prompts(1)[0], max_new_tokens=4, kv_window=16)
+        ok = router.submit(_prompts(1)[0], max_new_tokens=4)
+        router.run_until_idle()
+        assert bad.finish_reason == "failed"
+        assert ok.finish_reason == "length"
+        s = router.summary()
+        assert s["failed_requests"] == 1 and s["healthy_replicas"] == 1
+        rej = [e for e in router.telemetry.recent_events
+               if e["event"] == "rejected"]
+        assert rej and "kv_window" in rej[0]["error"]
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------------------
+# in-process jax: per-request KV windows + engine preemption
+
+@functools.cache
+def _setup_win():
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128)
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _win_engine(window, **kw):
+    model, params = _setup_win()
+    return ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                         block_size=8, num_blocks=64, kv_sink_tokens=8,
+                         kv_window_tokens=window, **kw)
+
+
+def test_per_request_window_override_bitwise():
+    """submit(kv_window=W) on a window-2W pool decodes BITWISE like a
+    pool natively configured at W (prompt shorter than W: prefill masks
+    under the pool config, the override owns every decoded token) —
+    while a no-op override and the untouched default stay bitwise with
+    the wide pool. Overrides only tighten: a wider ask clamps to the
+    pool; both round up to whole blocks."""
+    def run(window, **skw):
+        eng = _win_engine(window)
+        eng.warmup(prompt_lens=(16,))
+        req = eng.submit(np.arange(1, 11, dtype=np.int32),
+                         max_new_tokens=48, **skw)
+        eng.run_until_idle()
+        toks = list(req.new_tokens)
+        assert req.finish_reason == "length"
+        eng.close()
+        return toks, req
+
+    tight, _ = run(16)
+    overridden, req = run(32, kv_window=16, kv_sink=8)
+    assert req.kv_window == 16 and req.kv_sink == 8
+    assert overridden == tight
+    wide, _ = run(32)
+    noop, _ = run(32, kv_window=32)
+    assert noop == wide
+    assert overridden != wide          # the window actually bites
+    # tighten-only + block rounding
+    eng = _win_engine(32)
+    r = eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   kv_window=1000, kv_sink=3)
+    assert r.kv_window == 32 and r.kv_sink == 8   # clamped to the pool
+    r2 = eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                    kv_window=9)
+    assert r2.kv_window == 16          # rounded UP to whole blocks
+    eng.close()
+
+
+def test_kv_override_rejection_walls():
+    """Incompatible pools reject the override at submit() with a
+    loud ValueError: dense, windowless-paged, pallas decode, and
+    prefill_only handoffs."""
+    model, params = _setup_win()
+    dense = ServingEngine(model, params, num_slots=2, prefill_bucket=16)
+    with pytest.raises(ValueError, match="paged engine"):
+        dense.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                     kv_window=16)
+    dense.close()
+    windowless = ServingEngine(model, params, num_slots=2,
+                               prefill_bucket=16, block_size=8)
+    with pytest.raises(ValueError, match="windowed pool"):
+        windowless.submit(np.arange(1, 5, dtype=np.int32),
+                          max_new_tokens=4, kv_window=16)
+    windowless.close()
+    pal = _win_engine(32, paged_attn="pallas")
+    assert not pal.per_slot_limits
+    with pytest.raises(ValueError, match="Pallas"):
+        pal.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   kv_window=16)
+    pal.close()
+    eng = _win_engine(32)
+    with pytest.raises(ValueError, match="kv_window must be >= 1"):
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   kv_window=0)
+    with pytest.raises(ValueError, match="kv_sink must be >= 0"):
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   kv_sink=-1)
+    with pytest.raises(ValueError, match="KV handoff"):
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   kv_window=16, prefill_only=True)
+    eng.close()
+
+
+def test_engine_preempt_request_lossless_and_states():
+    """preempt_request frees the slot NOW and keeps every delivered
+    token; submit(generated=...) resumes the stream bitwise. Queued
+    requests just leave the queue; mid-prefill and foreign requests
+    are refused (False), never half-torn."""
+    model, params = _setup_win()
+    eng = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                        block_size=8, num_blocks=64)
+    eng.warmup(prompt_lens=(16,))
+    p = np.arange(1, 9, dtype=np.int32)
+    ref = eng.submit(np.array(p), max_new_tokens=12)
+    eng.run_until_idle()
+    want = list(ref.new_tokens)
+    r2 = eng.submit(np.array(p), max_new_tokens=12)
+    for _ in range(4):
+        eng.step()
+    got = list(r2.new_tokens)
+    assert 0 < len(got) < 12
+    assert eng.preempt_request(r2)
+    assert r2.done and r2.finish_reason == "preempted"
+    assert not eng.preempt_request(r2)          # already retired
+    r3 = eng.submit(np.array(p), max_new_tokens=12 - len(got),
+                    generated=got)
+    eng.run_until_idle()
+    assert got + list(r3.new_tokens) == want
+    # queued preemption: never activated, just leaves the queue
+    stuck = [eng.submit(np.array(p), max_new_tokens=4)
+             for _ in range(4)]
+    assert eng.preempt_request(stuck[-1])
+    eng.run_until_idle()
+    assert stuck[-1].finish_reason == "preempted"
+    assert all(r.finish_reason == "length" for r in stuck[:-1])
+    assert eng.summary()["preempted_requests"] == 2
+    eng.close()
+
+
+# ----------------------------------------------------------------------
+# subprocess mode (full tier: spawns real workers that import jax)
+
+def test_subprocess_autoscale_e2e_no_orphans():
+    """The e2e: subprocess workers under a flash crowd — the autoscaler
+    spawns a joiner from the base spec (async warm through QUARANTINE,
+    run.py env contract), the joiner rejoins and the fleet serves, then
+    a graceful remove drains it to a tombstone whose process EXITS; at
+    close, zero orphan processes fleet-wide."""
+    import time
+
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 2, "prefill_bucket": 16}}
+    router = ReplicaRouter(workers=[spec], warmup_lens=(16, 32))
+    procs = []
+    try:
+        router.warmup()
+        j = router.add_replica()
+        procs = [rep.proc for rep in router._replicas]
+        assert router._status[j] == "quarantined"   # warming async
+        deadline = time.time() + 300
+        prompts = _prompts(4)
+        while time.time() < deadline and router._status[j] != "healthy":
+            router.step()
+            time.sleep(0.01)
+        assert router._status[j] == "healthy", router._status
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.output_ids, _ref(p, 6),
+                                          err_msg=f"request {r.id}")
+        assert router.remove_replica(index=j) == j
+        deadline = time.time() + 60
+        while time.time() < deadline \
+                and router.summary()["statuses"][j] != "removed":
+            router.step()
+        s = router.summary()
+        assert s["statuses"][j] == "removed"
+        assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+        # the tombstone's worker process is already gone at removal
+        deadline = time.time() + 15
+        while time.time() < deadline and procs[j].poll() is None:
+            time.sleep(0.1)
+        assert procs[j].poll() is not None
+        # the survivor still serves
+        r = router.submit(prompts[0], max_new_tokens=6)
+        router.run_until_idle(max_steps=200000)
+        np.testing.assert_array_equal(r.output_ids, _ref(prompts[0], 6))
+    finally:
+        router.close()
+    deadline = time.time() + 15
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    assert all(p.poll() is not None for p in procs), \
+        [p.poll() for p in procs]
