@@ -161,14 +161,46 @@ _QUICK = (
     # serving table — all on test-size models. The paged HLO pins ride
     # the already-quick test_serving_invariants parametrization.
     "test_paging.py",
-    # speculative decoding (ISSUE 8): the whole file is quick-tier by
-    # design — rejection-kernel units + the chi-squared losslessness
-    # check, offline generate_speculative bitwise parity (self-draft,
-    # truncated draft, int8, GQA/RoPE, stop ids), and the serving
-    # engine's spec tick (greedy parity incl. prefix hits + preemption,
-    # seeded determinism, zero recompiles, telemetry columns) — all on
-    # test-size models. The spec HLO pin rides test_serving_invariants.
-    "test_spec.py",
+    # speculative decoding (ISSUE 8): rejection-kernel units + the
+    # chi-squared losslessness check, offline generate_speculative
+    # bitwise parity (self-draft, truncated draft, int8, GQA/RoPE,
+    # stop ids), and the serving engine's spec tick (greedy parity
+    # incl. prefix hits + preemption, seeded determinism, zero
+    # recompiles, telemetry columns) — all on test-size models. The
+    # spec HLO pin rides test_serving_invariants.
+    "test_spec.py::TestSpeculativeAccept",
+    "test_spec.py::test_slot_filtered_probs_matches_sampler_distribution",
+    "test_spec.py::test_offline_greedy_bitwise_gpt2",
+    "test_spec.py::test_offline_greedy_bitwise_llama_gqa_rope",
+    "test_spec.py::test_offline_greedy_bitwise_int8fwd",
+    "test_spec.py::test_offline_greedy_bitwise_truncated_draft",
+    "test_spec.py::test_offline_greedy_bitwise_stop_ids",
+    "test_spec.py::test_offline_falls_back_when_context_tight",
+    "test_spec.py::test_truncated_draft_validations",
+    "test_spec.py::test_engine_spec_parity_greedy",
+    "test_spec.py::test_engine_spec_parity_llama_and_int8",
+    "test_spec.py::test_engine_spec_parity_truncated_draft",
+    "test_spec.py::test_engine_spec_prefix_hits_stay_bitwise",
+    "test_spec.py::test_engine_spec_preemption_stays_bitwise",
+    "test_spec.py::test_engine_spec_zero_recompiles_and_determinism",
+    "test_spec.py::test_engine_spec_requires_paged",
+    "test_spec.py::test_engine_spec_telemetry_rows",
+    # learned drafting (ISSUE 16): the make_draft validation walls, the
+    # engine swap refusal walls, the fleet-wide architecture refusal,
+    # and the ISSUE-mandated in-process fleet broadcast (same-structure
+    # tree swapped mid-stream on 2 replicas: bitwise vs generate(),
+    # per-replica identity in summary/telemetry/report). Everything
+    # that touches distill_corpus's teacher-generate compile or trains
+    # — distill loss smoke, corpus determinism, offline bitwise
+    # anchors, adaptive-k retrace tripwire, engine mid-stream swap,
+    # checkpoint round-trip, the SUBPROCESS wire-op e2e and the example
+    # run — stays full-suite-only: tier-1 sits within ~2% of its 870 s
+    # budget, so quick-tier additions here are capped at the ~25 s the
+    # fleet-swap anchor plus walls cost.
+    "test_spec.py::test_make_draft_validations",
+    "test_spec.py::test_engine_draft_hot_swap_refusals",
+    "test_distill.py::test_router_inprocess_fleet_swap_midstream_bitwise",
+    "test_distill.py::test_router_refuses_mismatched_draft_fleet_wide",
     # replica router chaos suite (ISSUE 9): fault-spec units, the
     # resume-from-tokens engine satellite, crash-mid-stream bitwise
     # parity (dense + paged), the hang watchdog bound, NaN quarantine +
@@ -285,3 +317,28 @@ def pytest_collection_modifyitems(items):
             item.add_marker(pytest.mark.quick)
         else:
             item.add_marker(pytest.mark.slow)
+
+
+_EXIT_STATUS = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    # Interpreter teardown after a full tier-1 run costs ~30 s: GC and
+    # XLA-client destructors walk hundreds of compiled executables and
+    # device arrays accumulated across ~330 tests, after every test has
+    # already passed or failed. That dead time counts against the
+    # tier-1 wall-clock budget, so skip it: once the terminal summary
+    # is out, flush and exit with the session's real status. (No
+    # coverage/teardown-dependent plugins are in play; pytest's tmp
+    # dirs are reaped lazily by later runs.)
+    if _EXIT_STATUS[0] is not None:
+        import os as _os
+        import sys as _sys
+
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+        _os._exit(_EXIT_STATUS[0])

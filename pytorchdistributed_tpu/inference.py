@@ -179,7 +179,7 @@ def slot_filtered_probs(logits, temperature, top_k, top_p, *,
 
 
 def speculative_accept(draft_tokens, q_probs, p_probs, unif, res_keys,
-                       greedy):
+                       greedy, k_eff=None):
     """Vectorized lossless rejection sampling (Leviathan et al. 2023;
     Chen et al. 2023): decide, per row, how many draft proposals the
     target model keeps, and sample the one correction/bonus token that
@@ -205,7 +205,19 @@ def speculative_accept(draft_tokens, q_probs, p_probs, unif, res_keys,
     Returns ``(tokens [n, k+1], n_accept [n])``: tokens[:, :n_accept] are
     the kept proposals and tokens[:, n_accept] the correction/bonus; the
     caller reads exactly n_accept+1 tokens per row (later positions hold
-    leftover proposals)."""
+    leftover proposals).
+
+    ``k_eff`` (optional [n] int32 in [1, k]) is the per-row EFFECTIVE
+    proposal depth — adaptive k (ISSUE 16) as a masked width inside the
+    fixed k-wide program, so a per-slot depth change never retraces.
+    Proposals at positions >= a row's k_eff are treated as never made:
+    acceptance stops there, and a row that accepts all k_eff proposals
+    draws its bonus from the FULL target distribution at position k_eff
+    (q forced to 0 — that position's proposal was not offered, so the
+    rejection-resample residual would be the wrong measure). The emitted
+    prefix stays exactly target-distributed for every k_eff; greedy rows
+    are bitwise-invariant to it (the correction is argmax(p) either
+    way)."""
     n, k = draft_tokens.shape
     rows = jnp.arange(n)
     p_at = jnp.take_along_axis(
@@ -215,9 +227,12 @@ def speculative_accept(draft_tokens, q_probs, p_probs, unif, res_keys,
     # u < min(1, p/q)  <=>  u*q < p for u in [0,1): no division, and the
     # greedy one-hot case stays exact (q_at == 1.0 exactly)
     accept = unif * q_at < p_at                              # [n, k]
+    if k_eff is not None:
+        accept = accept & (jnp.arange(k)[None, :] < k_eff[:, None])
     n_accept = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
     p_cut = p_probs[rows, n_accept]                          # [n, vocab]
-    q_cut = jnp.where((n_accept < k)[:, None],
+    lim = k if k_eff is None else jnp.minimum(k_eff, k)
+    q_cut = jnp.where((n_accept < lim)[:, None],
                       q_probs[rows, jnp.minimum(n_accept, k - 1)], 0.0)
     res = jnp.maximum(p_cut - q_cut, 0.0)
     tot = res.sum(axis=-1, keepdims=True)
@@ -566,10 +581,74 @@ def truncated_draft(model, params, num_layers: int):
     return draft, {"params": out}
 
 
+def make_draft(model, params, *, num_layers: int | None = None,
+               spec_heads: int = 0, seed: int = 0):
+    """(draft_model, draft_params) for speculative decoding — the one
+    constructor behind every draft shape (ISSUE 16): ``num_layers`` < the
+    target's truncates the block stack (truncated_draft, the free warm
+    init), None/equal keeps the full stack (self-draft-sized);
+    ``spec_heads`` > 0 attaches that many multi-token proposal heads
+    (models ProposalHeads), ZERO-initialized so at step 0 every head
+    reproduces the base head's distribution exactly — init is
+    deterministic whatever ``seed`` (kept for API symmetry). The result
+    drops straight into generate_speculative / ServingEngine
+    ``draft_config``/``draft_params``, and training/distill.py uses it as
+    the student's warm start."""
+    import flax.linen as nn
+
+    from pytorchdistributed_tpu.models.transformer import ProposalHeads
+
+    cfg = model.cfg
+    if num_layers is None or num_layers == cfg.num_layers:
+        draft = model
+        dparams = {"params": params["params"] if "params" in params
+                   else params}
+    else:
+        draft, dparams = truncated_draft(model, params, num_layers)
+    if spec_heads:
+        if spec_heads < 0:
+            raise ValueError(f"spec_heads must be >= 0, got {spec_heads}")
+        dcfg = dataclasses.replace(draft.cfg, spec_heads=spec_heads)
+        draft = draft.clone(cfg=dcfg)
+        head_tree = nn.meta.unbox(ProposalHeads(dcfg).init(
+            jax.random.key(seed),
+            jnp.zeros((1, dcfg.embed_dim), dcfg.dtype))["params"])
+        p = dict(dparams["params"])
+        p["heads"] = head_tree
+        dparams = {"params": p}
+    return draft, dparams
+
+
+def _verify_chunk(model, weights, cache, tok, d_prop, q_probs, unif,
+                  res_keys, temperature, top_k, top_p, *, spec_k: int,
+                  candidates: int, k_eff=None):
+    """The verify half of one speculative round — ONE target forward
+    over [tok, d_1..d_k] plus the lossless rejection kernel. Shared by
+    the sequential-rollout and head-parallel draft paths (ISSUE 16), so
+    the losslessness-critical math exists exactly once whatever proposed
+    the tokens. Returns ``(cache, emitted [n, spec_k+1], n_accept)``."""
+    n = tok.shape[0]
+    chunk = jnp.concatenate([tok[:, None], d_prop], axis=1)
+    logits, mut = model.apply(
+        {"params": weights, "cache": cache}, chunk, mutable=["cache"])
+    flat = logits.reshape(n * (spec_k + 1), -1).astype(jnp.float32)
+
+    def rep(a):
+        return jnp.repeat(a, spec_k + 1, axis=0)
+
+    p_probs = slot_filtered_probs(
+        flat, rep(temperature), rep(top_k), rep(top_p),
+        candidates=candidates).reshape(n, spec_k + 1, -1)
+    emitted, n_accept = speculative_accept(
+        d_prop, q_probs, p_probs, unif, res_keys, temperature <= 0.0,
+        k_eff=k_eff)
+    return mut["cache"], emitted, n_accept
+
+
 def draft_and_verify(model, draft_model, weights, draft_weights, cache,
                      draft_cache, tok, draft_keys, unif, res_keys,
                      temperature, top_k, top_p, *, spec_k: int,
-                     candidates: int):
+                     candidates: int, k_eff=None):
     """One draft-and-verify round over per-row decode state — the
     losslessness-critical core shared by generate_speculative and the
     serving engine's spec_decode_tick (they differ only in how caches
@@ -582,10 +661,10 @@ def draft_and_verify(model, draft_model, weights, draft_weights, cache,
     [tok, d_1..d_k], and rejection-samples per row. ``draft_keys`` is a
     [spec_k+1, n] key array (one stream per rollout step per row);
     ``unif`` [n, spec_k] are the accept coins, ``res_keys`` [n] the
-    residual/bonus streams. Returns ``(cache, draft_cache, emitted
-    [n, spec_k+1], n_accept [n])`` — the caller consumes exactly
-    n_accept+1 tokens per row."""
-    n = tok.shape[0]
+    residual/bonus streams; ``k_eff`` (optional [n]) masks each row's
+    effective proposal depth (see speculative_accept). Returns
+    ``(cache, draft_cache, emitted [n, spec_k+1], n_accept [n])`` — the
+    caller consumes exactly n_accept+1 tokens per row."""
 
     def dstep(carry, keys_j):
         dc, t = carry
@@ -603,20 +682,84 @@ def draft_and_verify(model, draft_model, weights, draft_weights, cache,
         dstep, (draft_cache, tok), draft_keys)
     d_prop = dtoks[:spec_k].T                        # [n, k]
     q_probs = jnp.moveaxis(qs[:spec_k], 0, 1)        # [n, k, vocab]
-    chunk = jnp.concatenate([tok[:, None], d_prop], axis=1)
-    logits, mut = model.apply(
-        {"params": weights, "cache": cache}, chunk, mutable=["cache"])
-    flat = logits.reshape(n * (spec_k + 1), -1).astype(jnp.float32)
+    cache, emitted, n_accept = _verify_chunk(
+        model, weights, cache, tok, d_prop, q_probs, unif, res_keys,
+        temperature, top_k, top_p, spec_k=spec_k, candidates=candidates,
+        k_eff=k_eff)
+    return cache, draft_cache, emitted, n_accept
+
+
+def draft_propose_heads(draft_model, draft_weights, draft_cache,
+                        prev_tokens, prev_idx, draft_keys, temperature,
+                        top_k, top_p, *, spec_k: int, candidates: int):
+    """ONE head-parallel draft forward proposing all spec_k tokens
+    (ISSUE 16, the Medusa shape): the draft processes ``prev_tokens`` —
+    the PREVIOUS round's emitted buffer [n, spec_k+1], whose writes land
+    at the caller-stamped draft positions and cover that round's
+    rejected-suffix draft K/V (the same covering-writes property the
+    target cache relies on) — reads the hidden state at each row's last
+    live index ``prev_idx``, and samples proposal 1 from the base head
+    and proposals 2..k from the multi-token heads, all conditioned on
+    the same hidden state (head proposals are offset-specialized, not
+    sequentially conditioned — the acceptance-for-latency trade).
+    ``draft_keys`` is the SAME [spec_k+1, n] key array the sequential
+    rollout consumes: proposal j samples with stream j either way.
+    Returns ``(draft_cache, d_prop [n, k], q_probs [n, k, vocab])``."""
+    n = prev_tokens.shape[0]
+    hid, mut = draft_model.apply(
+        {"params": draft_weights, "cache": draft_cache}, prev_tokens,
+        method="hidden_states", mutable=["cache"])
+    draft_cache = mut["cache"]
+    hsel = jnp.take_along_axis(
+        hid, prev_idx[:, None, None], axis=1)[:, 0]   # [n, embed]
+    # the cache collection rides along read-only: decode-mode setup
+    # declares position variables even on the projection-only methods
+    base = draft_model.apply(
+        {"params": draft_weights, "cache": draft_cache}, hsel,
+        method="logits_from_hidden")
+    heads = draft_model.apply(
+        {"params": draft_weights, "cache": draft_cache}, hsel,
+        method="head_logits")
+    all_lg = jnp.concatenate(
+        [base[:, None], heads[:, :spec_k - 1]],
+        axis=1).astype(jnp.float32)                   # [n, k, vocab]
+    flat = all_lg.reshape(n * spec_k, -1)
 
     def rep(a):
-        return jnp.repeat(a, spec_k + 1, axis=0)
+        return jnp.repeat(a, spec_k, axis=0)
 
-    p_probs = slot_filtered_probs(
-        flat, rep(temperature), rep(top_k), rep(top_p),
-        candidates=candidates).reshape(n, spec_k + 1, -1)
-    emitted, n_accept = speculative_accept(
-        d_prop, q_probs, p_probs, unif, res_keys, temperature <= 0.0)
-    return mut["cache"], draft_cache, emitted, n_accept
+    keys = jnp.swapaxes(draft_keys[:spec_k], 0, 1).reshape(n * spec_k)
+    d_prop = sample_slots(flat, keys, rep(temperature), rep(top_k),
+                          rep(top_p), candidates=candidates)
+    q_probs = slot_filtered_probs(flat, rep(temperature), rep(top_k),
+                                  rep(top_p), candidates=candidates)
+    return (draft_cache, d_prop.reshape(n, spec_k),
+            q_probs.reshape(n, spec_k, -1))
+
+
+def draft_and_verify_heads(model, draft_model, weights, draft_weights,
+                           cache, draft_cache, tok, prev_tokens, prev_idx,
+                           draft_keys, unif, res_keys, temperature, top_k,
+                           top_p, *, spec_k: int, candidates: int,
+                           k_eff=None):
+    """The head-parallel twin of draft_and_verify: the draft's k+1-step
+    sequential rollout collapses to a single forward over the previous
+    round's emitted buffer (draft_propose_heads), and the verify half is
+    the SAME _verify_chunk — rejection kernel, covering-writes, and the
+    no-rollback property are untouched, so losslessness never forks.
+    Caller contract: ``draft_cache`` positions are stamped at the
+    previous round's start (one round behind the target's), so this
+    forward writes the emitted tokens' draft K/V exactly where the next
+    round attends them."""
+    draft_cache, d_prop, q_probs = draft_propose_heads(
+        draft_model, draft_weights, draft_cache, prev_tokens, prev_idx,
+        draft_keys, temperature, top_k, top_p, spec_k=spec_k,
+        candidates=candidates)
+    cache, emitted, n_accept = _verify_chunk(
+        model, weights, cache, tok, d_prop, q_probs, unif, res_keys,
+        temperature, top_k, top_p, spec_k=spec_k, candidates=candidates,
+        k_eff=k_eff)
+    return cache, draft_cache, emitted, n_accept
 
 
 @functools.partial(
@@ -635,8 +778,17 @@ def _speculative_jit(model, draft_model, params, draft_params, prompt, rng,
     counters from the per-row length vector (reset_cache_positions), so
     rejected-suffix K/V needs no rollback: the next round's k+1 writes
     land at [len, len+k] and always cover the stale region, and the
-    position mask keeps anything beyond a row's length unattendable."""
+    position mask keeps anything beyond a row's length unattendable.
+
+    When the draft carries proposal heads (cfg.spec_heads > 0, ISSUE 16)
+    the carry gains the head-parallel round state — prev_toks (last
+    round's emitted buffer, the NEXT draft forward's input chunk),
+    prev_idx (each row's last live index in it) and prev_pos (the draft
+    positions it writes at, one round behind the target's) — and the
+    draft's sequential rollout becomes one forward; the verify half and
+    everything below it are byte-for-byte the same code path."""
     TRACE_COUNTS["generate_speculative"] += 1
+    heads_mode = draft_model.cfg.spec_heads > 0
     b, plen = prompt.shape
     weights = params["params"] if "params" in params else params
     dweights = (draft_params["params"] if "params" in draft_params
@@ -668,17 +820,31 @@ def _speculative_jit(model, draft_model, params, draft_params, prompt, rng,
         return jnp.any(~carry[5])
 
     def body(carry):
-        t_cache, d_cache, out, n_out, tok, done, pos, key = carry
+        if heads_mode:
+            (t_cache, d_cache, out, n_out, tok, done, pos, key,
+             prev_toks, prev_idx, prev_pos) = carry
+        else:
+            t_cache, d_cache, out, n_out, tok, done, pos, key = carry
         t_cache = reset_cache_positions(t_cache, pos)
-        d_cache = reset_cache_positions(d_cache, pos)
         key, kd, ka, kr = jax.random.split(key, 4)
         draft_keys = jax.vmap(lambda kj: jax.random.split(kj, b))(
             jax.random.split(kd, spec_k + 1))
         unif = jax.random.uniform(ka, (b, spec_k))
-        t_cache, d_cache, emitted, n_acc = draft_and_verify(
-            model, draft_model, weights, dweights, t_cache, d_cache, tok,
-            draft_keys, unif, jax.random.split(kr, b), temps, tks, tps,
-            spec_k=spec_k, candidates=candidates)
+        if heads_mode:
+            # the draft writes last round's emitted buffer, so its
+            # positions lag the target's by one round
+            d_cache = reset_cache_positions(d_cache, prev_pos)
+            t_cache, d_cache, emitted, n_acc = draft_and_verify_heads(
+                model, draft_model, weights, dweights, t_cache, d_cache,
+                tok, prev_toks, prev_idx, draft_keys, unif,
+                jax.random.split(kr, b), temps, tks, tps,
+                spec_k=spec_k, candidates=candidates)
+        else:
+            d_cache = reset_cache_positions(d_cache, pos)
+            t_cache, d_cache, emitted, n_acc = draft_and_verify(
+                model, draft_model, weights, dweights, t_cache, d_cache,
+                tok, draft_keys, unif, jax.random.split(kr, b), temps,
+                tks, tps, spec_k=spec_k, candidates=candidates)
         if eos_ids:
             # a stop id freezes the rest of the round: everything after
             # it emits the first stop id, exactly generate()'s frozen-row
@@ -700,15 +866,31 @@ def _speculative_jit(model, draft_model, params, draft_params, prompt, rng,
             live = jnp.arange(spec_k + 1)[None, :] <= n_acc[:, None]
             new_done = new_done | (
                 ~done & (matches_stop(emitted, eos_ids) & live).any(axis=1))
+        if heads_mode:
+            # next round's draft input: this round's emitted buffer,
+            # whose row-0 token sits one past the pre-advance pos
+            prev_toks = jnp.where(done[:, None], prev_toks, emitted)
+            prev_idx = jnp.where(done, prev_idx, n_acc)
+            prev_pos = jnp.where(done, prev_pos, pos + 1)
         # freeze pos at the pre-round value for rows that just finished:
         # live rows keep pos == plen + n_out - 1 <= plen + max_new - 2,
         # so verify writes never pass plen + max_new + spec_k - 2 (the
         # wrapper's validation slack)
         pos = jnp.where(new_done, pos, pos + m_emit)
+        if heads_mode:
+            return (t_cache, d_cache, out, n_out, tok, new_done, pos, key,
+                    prev_toks, prev_idx, prev_pos)
         return (t_cache, d_cache, out, n_out, tok, new_done, pos, key)
 
     carry = (t_cache, d_cache, out, n_out, first, done, pos, rng)
-    _, _, out, n_out, _, _, _, _ = lax.while_loop(cond, body, carry)
+    if heads_mode:
+        # round 1's draft chunk: the first committed token plus padding
+        # (index 0 is the only live position), written at the target's
+        # current pos — the draft cache holds only the prompt so far
+        prev_toks = jnp.zeros((b, spec_k + 1), jnp.int32).at[:, 0].set(first)
+        carry = carry + (prev_toks, jnp.zeros((b,), jnp.int32), pos)
+    fin = lax.while_loop(cond, body, carry)
+    out, n_out = fin[2], fin[3]
     pad = eos_ids[0] if eos_ids else 0
     res = jnp.where(jnp.arange(width)[None, :] < n_out[:, None], out, pad)
     return jnp.concatenate([prompt, res[:, :max_new_tokens]], axis=1)
@@ -765,6 +947,12 @@ def generate_speculative(
         raise ValueError(
             f"draft vocab {draft_model.cfg.vocab_size} != target vocab "
             f"{model.cfg.vocab_size} (the draft proposes target tokens)")
+    if 0 < draft_model.cfg.spec_heads < spec_k - 1:
+        raise ValueError(
+            f"draft has {draft_model.cfg.spec_heads} proposal heads but "
+            f"spec_k={spec_k} needs {spec_k - 1} (base head proposes token "
+            f"1, head j token j+2; build the draft with make_draft("
+            f"spec_heads=spec_k-1))")
 
     def slot_clone(m, seq_len):
         return m.clone(cfg=dataclasses.replace(

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from pytorchdistributed_tpu.models.transformer import (
     Embedder,
     LMHead,
+    ProposalHeads,
     TransformerConfig,
     TransformerStack,
     _layer_norm,
@@ -35,6 +36,8 @@ class GPT2(nn.Module):
         self.ln_f = _layer_norm(cfg, None)
         if not cfg.tie_embeddings:
             self.lm_head = LMHead(cfg)
+        if cfg.spec_heads:
+            self.heads = ProposalHeads(cfg)
 
     def _backbone(self, tokens, deterministic):
         x = self.embed(tokens)
@@ -43,11 +46,53 @@ class GPT2(nn.Module):
 
     def __call__(self, tokens, *, deterministic: bool = True):
         x = self._backbone(tokens, deterministic)
+        if self.cfg.spec_heads and self.is_initializing():
+            # materialize the (compact) proposal-head params at init —
+            # __call__ is every init path's trace, but only spec_logits /
+            # head_logits ever runs the heads
+            self.heads(x)
         if self.cfg.tie_embeddings:
             logits = self.embed.attend(x)
         else:
             logits = self.lm_head(x)
         return logits.astype(jnp.float32)
+
+    # -- multi-token proposal heads (ISSUE 16; cfg.spec_heads > 0) -------
+
+    def hidden_states(self, tokens, *, deterministic: bool = True):
+        """Backbone + final norm only — the draft decode entry when this
+        model carries proposal heads: the caller selects the one live
+        position per row, then runs logits_from_hidden/head_logits on the
+        selection instead of projecting every chunk position through the
+        vocab matrix. Cache-mutating exactly like __call__."""
+        return self._backbone(tokens, deterministic)
+
+    def logits_from_hidden(self, x):
+        """The base next-token logits for already-normed hidden states
+        (the second half of __call__; no cache touched)."""
+        if self.cfg.tie_embeddings:
+            return self.embed.attend(x).astype(jnp.float32)
+        return self.lm_head(x).astype(jnp.float32)
+
+    def head_logits(self, x):
+        """Proposal-head logits ``[..., spec_heads, vocab]`` (fp32) for
+        final hidden states x — head j predicts the token j+2 ahead,
+        through the SAME tied/untied projection as the base head."""
+        h = self.heads(x)
+        if self.cfg.tie_embeddings:
+            return self.embed.attend(h).astype(jnp.float32)
+        return self.lm_head(h).astype(jnp.float32)
+
+    def spec_logits(self, tokens, *, deterministic: bool = True):
+        """``[b, s, spec_heads + 1, vocab]`` fp32 — index 0 the base
+        next-token logits, index j+1 head j's (the token j+2 ahead).
+        The distillation training target shape (training/distill.py):
+        every position trains the base head AND each proposal head on
+        its own shifted offset in one forward."""
+        x = self._backbone(tokens, deterministic)
+        base = self.logits_from_hidden(x)
+        return jnp.concatenate([base[..., None, :], self.head_logits(x)],
+                               axis=-2)
 
     def loss_per_position(self, tokens, targets, *,
                           deterministic: bool = True):

@@ -200,6 +200,18 @@ class TransformerConfig:
     # sink/window as STATIC parameters); off by default so the static
     # mask — and every pinned HLO — is byte-identical.
     per_slot_kv_limits: bool = False
+    # Multi-token proposal heads (ISSUE 16, the Medusa recipe — Cai et
+    # al. 2024) for a speculative DRAFT model: > 0 adds that many extra
+    # decoding heads, each a zero-init SiLU residual block on the final
+    # hidden state feeding the SHARED logit projection, so head j
+    # predicts the token j+2 positions ahead and at init reproduces the
+    # base head's distribution exactly. ONE draft forward then proposes
+    # spec_heads+1 tokens instead of rolling the draft autoregressively —
+    # inference.draft_and_verify collapses its k+1-step scan to a single
+    # head-parallel forward when the draft carries heads. Never on the
+    # TARGET model: the verify forward and the rejection kernel are
+    # untouched, so losslessness does not depend on this knob.
+    spec_heads: int = 0
     scan_layers: bool = True
     remat: bool = False
     # What the checkpoint keeps when remat=True. "full" recomputes the whole
@@ -348,6 +360,9 @@ class TransformerConfig:
                     f"kv_sink_tokens {self.kv_sink_tokens} must be "
                     f"multiples of kv_block_size {self.kv_block_size} "
                     f"(retirement is whole-block)")
+        if self.spec_heads < 0:
+            raise ValueError(f"spec_heads must be >= 0, got "
+                             f"{self.spec_heads}")
         if self.decode_attend_len is not None and (
                 self.decode_attend_len < 1
                 or self.decode_attend_len > self.max_seq_len):
@@ -1208,6 +1223,39 @@ class LMHead(nn.Module):
         if dg is None:
             return x @ kernel
         return dg(x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+
+
+class ProposalHeads(nn.Module):
+    """Medusa-style multi-token proposal heads (cfg.spec_heads > 0, ISSUE
+    16): head j maps the final hidden state x to ``x + silu(W_j x)`` with
+    W_j (and its bias) ZERO-initialized — silu(0) == 0, so every head's
+    hidden state starts exactly equal to x and its logits (through the
+    shared tied/untied projection the model owns) start exactly equal to
+    the base next-token head's; silu'(0) == 0.5 keeps gradients flowing,
+    so distillation (training/distill.py) specializes each head to its
+    own offset from a sane start. Param tree: ``heads/head_{j}/...``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        """[..., embed] -> [..., spec_heads, embed] per-head hidden
+        states, ready for the model's shared logit projection."""
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        outs = []
+        for j in range(cfg.spec_heads):
+            r = nn.Dense(
+                cfg.embed_dim, use_bias=True, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    # (None, EMBED), not (EMBED, EMBED): logical axis
+                    # names may not repeat within one array
+                    nn.initializers.zeros, (None, Logical.EMBED)),
+                bias_init=nn.initializers.zeros,
+                name=f"head_{j}")(x)
+            outs.append(x + nn.silu(r))
+        return jnp.stack(outs, axis=-2)
 
 
 class Embedder(nn.Module):

@@ -66,6 +66,7 @@ import collections
 import contextlib
 import dataclasses
 import functools
+import hashlib
 import itertools
 import os
 import time
@@ -77,6 +78,7 @@ import numpy as np
 from pytorchdistributed_tpu.inference import (
     _zero_cache,
     draft_and_verify,
+    draft_and_verify_heads,
     kv_cache_bytes,
     sample_slots,
     stop_ids_tuple,
@@ -358,7 +360,7 @@ def paged_prefill_chunk(model, weights, cache, chunk, start, table_row,
     donate_argnames=("cache", "draft_cache"))
 def spec_decode_tick(model, draft_model, weights, draft_weights, cache,
                      draft_cache, tables, lengths, tokens, key_data, counts,
-                     temperature, top_k, top_p, *, spec_k: int,
+                     temperature, top_k, top_p, k_eff=None, *, spec_k: int,
                      candidates: int):
     """The speculative twin of paged_decode_tick (ISSUE 8): ONE compiled
     program per tick that (a) rolls the draft model ``spec_k + 1``
@@ -391,7 +393,13 @@ def spec_decode_tick(model, draft_model, weights, draft_weights, cache,
     round's streams, so a SAMPLED stream's post-resume suffix is a
     different (equally target-distributed) sample than the
     uninterrupted run's; greedy streams are bitwise-stable across
-    preemption either way (tests/test_spec.py pins that)."""
+    preemption either way (tests/test_spec.py pins that).
+
+    ``k_eff`` (optional [slots] int32, ISSUE 16) is the per-slot
+    EFFECTIVE proposal depth — a DYNAMIC operand of this fixed
+    spec_k-wide program, so the host can move it every tick (adaptive k)
+    with zero recompiles; see inference.speculative_accept for why the
+    masked width stays lossless."""
     TRACE_COUNTS["spec_decode_tick"] += 1
     cache = _override_paging(cache, tables, lengths)
     draft_cache = _override_paging(draft_cache, tables, lengths)
@@ -407,7 +415,48 @@ def spec_decode_tick(model, draft_model, weights, draft_weights, cache,
     return draft_and_verify(
         model, draft_model, weights, draft_weights, cache, draft_cache,
         tokens, draft_keys, unif, res_keys, temperature, top_k, top_p,
-        spec_k=spec_k, candidates=candidates)
+        spec_k=spec_k, candidates=candidates, k_eff=k_eff)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "draft_model", "spec_k", "candidates"),
+    donate_argnames=("cache", "draft_cache"))
+def spec_decode_tick_heads(model, draft_model, weights, draft_weights,
+                           cache, draft_cache, tables, lengths,
+                           draft_lengths, prev_tokens, prev_idx, tokens,
+                           key_data, counts, temperature, top_k, top_p,
+                           k_eff=None, *, spec_k: int, candidates: int):
+    """spec_decode_tick for a draft carrying multi-token proposal heads
+    (ISSUE 16): the draft's spec_k+1-step sequential rollout collapses to
+    ONE forward over each slot's PREVIOUS round's emitted buffer
+    (``prev_tokens`` [slots, spec_k+1], live up to ``prev_idx``), whose
+    writes land at ``draft_lengths`` — the previous round's start, one
+    round behind the target's ``lengths`` — through the SAME host-stamped
+    block tables. The verify forward, rejection kernel, PRNG stream
+    derivation, and host advance-by-n+1 contract are byte-for-byte
+    spec_decode_tick's, so losslessness and stream reproducibility never
+    fork; only the number of draft forwards per round changes (k+1 → 1).
+    Same returns; extra host duty: after the round, ``prev_tokens`` :=
+    this round's emitted buffer, ``prev_idx`` := n_accept,
+    ``draft_lengths`` := the pre-advance length + 1."""
+    TRACE_COUNTS["spec_decode_tick_heads"] += 1
+    cache = _override_paging(cache, tables, lengths)
+    draft_cache = _override_paging(draft_cache, tables, draft_lengths)
+    keys = jax.random.wrap_key_data(key_data)
+    base = jax.vmap(jax.random.fold_in)(keys, counts)
+    step1 = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 1)
+    draft_keys = jax.vmap(
+        lambda j: jax.vmap(jax.random.fold_in, in_axes=(0, None))(step1, j)
+    )(jnp.arange(spec_k + 1))
+    acc_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 2)
+    unif = jax.vmap(lambda k_: jax.random.uniform(k_, (spec_k,)))(acc_keys)
+    res_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base, 3)
+    return draft_and_verify_heads(
+        model, draft_model, weights, draft_weights, cache, draft_cache,
+        tokens, prev_tokens, prev_idx, draft_keys, unif, res_keys,
+        temperature, top_k, top_p, spec_k=spec_k, candidates=candidates,
+        k_eff=k_eff)
 
 
 def nan_params(weights):
@@ -770,6 +819,22 @@ class ServingEngine:
         self-drafts with the target model itself: acceptance ~1, the
         correctness/bring-up configuration.
       draft_params: the draft's variables (required with draft_config).
+        A draft whose config sets ``spec_heads > 0`` (ISSUE 16 —
+        inference.make_draft builds one) switches the tick to the
+        head-parallel program (spec_decode_tick_heads): one draft
+        forward proposes all spec_k tokens instead of a spec_k+1-step
+        rollout; needs spec_heads >= spec_k - 1.
+      adaptive_k: with spec_k > 0, drive each slot's EFFECTIVE proposal
+        depth from its measured acceptance EMA (ISSUE 16): a slot whose
+        draft keeps missing proposes fewer tokens next round, one whose
+        draft keeps landing proposes the full spec_k. The depth is a
+        masked width inside the fixed spec_k-wide compiled program — a
+        dynamic operand, ZERO recompiles as it moves — and the rejection
+        kernel stays lossless at any depth (the forced-stop bonus token
+        draws from the FULL target distribution; greedy streams are
+        bitwise-invariant to the mask). Default off: the accounting
+        (draft_tokens counts the effective depth) and the extra operand
+        change nothing unless asked for.
       compile_cache: the persistent AOT executable cache (ISSUE 10,
         runtime/compile_cache.py): a CompileCache, a directory path, or
         the default "auto" (the PTD_COMPILE_CACHE env contract; off
@@ -809,6 +874,12 @@ class ServingEngine:
         draft rollout always use the gather read.
     """
 
+    #: adaptive-k acceptance-EMA smoothing (ISSUE 16): high enough to
+    #: track a request moving between easy and hard spans within its own
+    #: lifetime, low enough that one unlucky round doesn't crater the
+    #: depth
+    SPEC_EMA_ALPHA = 0.2
+
     def __init__(self, model, params, *, num_slots: int = 4,
                  prefill_bucket: int = 128, candidates: int = 64,
                  mesh=None, telemetry: ServingTelemetry | None = None,
@@ -818,6 +889,7 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunks_per_step: int = 1,
                  spec_k: int = 0, draft_config=None, draft_params=None,
+                 adaptive_k: bool = False,
                  compile_cache="auto", kv_dtype: str | None = None,
                  kv_sink_tokens: int | None = None,
                  kv_window_tokens: int | None = None,
@@ -942,6 +1014,13 @@ class ServingEngine:
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.spec_k = spec_k
+        if adaptive_k and not spec_k:
+            raise ValueError(
+                "adaptive_k without spec_k > 0 — per-slot proposal depth "
+                "is a speculative-decode knob")
+        self.adaptive_k = bool(adaptive_k)
+        self._spec_heads = 0
+        self.draft_swaps = 0
         if spec_k:
             if not self.paged:
                 raise ValueError(
@@ -960,6 +1039,16 @@ class ServingEngine:
                 raise ValueError(
                     f"draft vocab {draft_config.vocab_size} != target "
                     f"vocab {model.cfg.vocab_size}")
+            # multi-token proposal heads (ISSUE 16): the base head
+            # proposes token 1, head j token j+2 — spec_k proposals need
+            # spec_k - 1 heads
+            self._spec_heads = int(draft_config.spec_heads)
+            if 0 < self._spec_heads < spec_k - 1:
+                raise ValueError(
+                    f"draft has {self._spec_heads} proposal heads but "
+                    f"spec_k={spec_k} needs {spec_k - 1} (build the "
+                    f"draft with inference.make_draft("
+                    f"spec_heads=spec_k-1))")
             # the draft shares the target's block TABLES (same block ids
             # into its own shallower pool), so its geometry must match
             draft_base = model.clone(cfg=dataclasses.replace(
@@ -974,9 +1063,15 @@ class ServingEngine:
                                   kv_sink_tokens=self.kv_sink_tokens,
                                   kv_window_tokens=self.kv_window_tokens,
                                   per_slot_kv_limits=self.per_slot_limits)
-            self._draft_weights = (draft_params["params"]
-                                   if "params" in draft_params
-                                   else draft_params)
+            # unbox (nn.meta) at boot: callers hand model.init output
+            # with LogicallyPartitioned boxes as often as plain trees,
+            # and the hot-swap path compares TREEDEFS — a boxed boot
+            # tree would refuse every trainer-produced (unboxed) swap
+            import flax.linen as nn
+
+            self._draft_weights = nn.meta.unbox(
+                draft_params["params"] if "params" in draft_params
+                else draft_params)
         self._weights = params["params"] if "params" in params else params
         with self._mesh_ctx():
             self._cache = _zero_cache(
@@ -998,6 +1093,20 @@ class ServingEngine:
         self._temps = np.zeros(num_slots, np.float32)
         self._top_ks = np.zeros(num_slots, np.int32)
         self._top_ps = np.ones(num_slots, np.float32)
+        if spec_k:
+            # per-slot speculative round state (ISSUE 16). Adaptive k:
+            # acceptance EMA drives each slot's effective proposal depth
+            # (a DYNAMIC operand of the fixed spec_k-wide tick — zero
+            # recompiles as it moves). Heads mode: the previous round's
+            # emitted buffer / live index / draft write position — the
+            # head-parallel draft forward's input (one round behind the
+            # target, see spec_decode_tick_heads).
+            self._accept_ema = np.ones(num_slots, np.float64)
+            self._k_eff = np.full(num_slots, spec_k, np.int32)
+            self._spec_prev_tokens = np.zeros((num_slots, spec_k + 1),
+                                              np.int32)
+            self._spec_prev_idx = np.zeros(num_slots, np.int32)
+            self._spec_prev_start = np.zeros(num_slots, np.int32)
         self._free = list(reversed(range(num_slots)))  # pop() -> slot 0
         self._queue: collections.deque[Request] = collections.deque()
         self._active: dict[int, Request] = {}
@@ -1241,20 +1350,49 @@ class ServingEngine:
         accepted span so the next tick's verify writes cover this round's
         rejected suffix. Returns the number of delivered tokens."""
         st = self._stats
+        heads = self._spec_heads > 0
+        adaptive = self.adaptive_k
         t0 = time.perf_counter()
         with self._span("serve/spec_tick"), self._mesh_ctx():
-            (self._cache, self._draft_cache, out, nacc) = self._aot_call(
-                "spec_decode_tick", spec_decode_tick,
-                (self._tick_model, self._draft_tick_model),
-                (self._weights, self._draft_weights, self._cache,
-                 self._draft_cache,
-                 jnp.asarray(self._tables), jnp.asarray(self._lengths),
-                 jnp.asarray(self._tokens), jnp.asarray(self._key_data),
-                 jnp.asarray(self._counts),
-                 jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                 jnp.asarray(self._top_ps)),
-                dict(spec_k=self.spec_k, candidates=self.candidates),
-                donation="cache,draft_cache")
+            # adaptive off keeps the k_eff=None operand list — the exact
+            # pre-ISSUE-16 program, so committed AOT caches and the
+            # serve_spec_tick invariant pin stay valid
+            tail = ((jnp.asarray(self._k_eff),) if adaptive else ())
+            if heads:
+                (self._cache, self._draft_cache, out,
+                 nacc) = self._aot_call(
+                    "spec_decode_tick_heads", spec_decode_tick_heads,
+                    (self._tick_model, self._draft_tick_model),
+                    (self._weights, self._draft_weights, self._cache,
+                     self._draft_cache,
+                     jnp.asarray(self._tables),
+                     jnp.asarray(self._lengths),
+                     jnp.asarray(self._spec_prev_start),
+                     jnp.asarray(self._spec_prev_tokens),
+                     jnp.asarray(self._spec_prev_idx),
+                     jnp.asarray(self._tokens),
+                     jnp.asarray(self._key_data),
+                     jnp.asarray(self._counts),
+                     jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                     jnp.asarray(self._top_ps)) + tail,
+                    dict(spec_k=self.spec_k, candidates=self.candidates),
+                    donation="cache,draft_cache")
+            else:
+                (self._cache, self._draft_cache, out,
+                 nacc) = self._aot_call(
+                    "spec_decode_tick", spec_decode_tick,
+                    (self._tick_model, self._draft_tick_model),
+                    (self._weights, self._draft_weights, self._cache,
+                     self._draft_cache,
+                     jnp.asarray(self._tables),
+                     jnp.asarray(self._lengths),
+                     jnp.asarray(self._tokens),
+                     jnp.asarray(self._key_data),
+                     jnp.asarray(self._counts),
+                     jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                     jnp.asarray(self._top_ps)) + tail,
+                    dict(spec_k=self.spec_k, candidates=self.candidates),
+                    donation="cache,draft_cache")
             toks = np.asarray(out)   # host sync: streaming delivery
             ns = np.asarray(nacc)
         dt = time.perf_counter() - t0
@@ -1269,16 +1407,34 @@ class ServingEngine:
         decoded = accepted = 0
         for slot, req in list(self._active.items()):
             n = int(ns[slot])
+            k_used = int(self._k_eff[slot]) if adaptive else self.spec_k
             # the round's writes + randomness are consumed whether or not
             # every token gets delivered; a retiring request's slot state
             # is reset by _release_slot anyway
+            old_len = int(self._lengths[slot])
             self._lengths[slot] += n + 1
             self._counts[slot] += n + 1
-            st["draft_tokens"] += self.spec_k
+            st["draft_tokens"] += k_used
             st["accepted_tokens"] += n
             st["target_forwards"] += 1
-            req.draft_tokens += self.spec_k
+            req.draft_tokens += k_used
             req.accepted_tokens += n
+            if heads:
+                # next round's draft chunk: this round's emitted buffer,
+                # live up to n, written one past the pre-advance length
+                self._spec_prev_tokens[slot] = toks[slot]
+                self._spec_prev_idx[slot] = n
+                self._spec_prev_start[slot] = old_len + 1
+            if adaptive:
+                # acceptance EMA -> next round's depth: propose about as
+                # many tokens as this slot has been accepting (never 0 —
+                # one proposal costs nothing extra, never > spec_k — the
+                # compiled width)
+                ema = ((1.0 - self.SPEC_EMA_ALPHA) * self._accept_ema[slot]
+                       + self.SPEC_EMA_ALPHA * (n / max(k_used, 1)))
+                self._accept_ema[slot] = ema
+                self._k_eff[slot] = min(
+                    self.spec_k, max(1, int(round(ema * self.spec_k))))
             accepted += n
             for j in range(n + 1):
                 self._deliver(req, int(toks[slot, j]))
@@ -1293,7 +1449,9 @@ class ServingEngine:
                 slot_occupancy=round(n_active / self.num_slots, 4),
                 blocks_used=used, blocks_free=self._alloc.free_count,
                 spec_k=self.spec_k, accepted_tokens=accepted,
-                decoded_tokens=decoded)
+                decoded_tokens=decoded,
+                accept_ema=round(float(self._accept_ema.mean()), 4),
+                k_eff=round(float(self._k_eff.mean()), 3))
         return decoded
 
     # ------------------------------------------------------------------
@@ -1503,6 +1661,8 @@ class ServingEngine:
         self._temps[slot] = req.sampling.temperature
         self._top_ks[slot] = req.sampling.top_k
         self._top_ps[slot] = req.sampling.top_p
+        if self.spec_k:
+            self._reset_spec_slot(slot, first, pf["true_len"])
         self._deliver(req, first)
         if req.prefill_only and not req.done:
             # PARK for handoff (ISSUE 12): the first token is
@@ -1676,8 +1836,25 @@ class ServingEngine:
         self._lengths[slot] = 0
         if self.per_slot_limits:
             self._set_slot_limits(slot, None, None)
+        if self.spec_k:
+            self._reset_spec_slot(slot, 0, 0)
         self._free.append(slot)
         self._temps[slot] = 0.0
+
+    def _reset_spec_slot(self, slot: int, first: int,
+                         true_len: int) -> None:
+        """Fresh per-slot speculative round state (ISSUE 16) — every
+        activation path (chunked-prefill completion, KV import) and
+        _release_slot funnel here: full proposal depth, EMA at 1.0, and
+        the heads-mode round-1 draft chunk = [first, pad...] written at
+        ``true_len`` (the first committed token's position — exactly the
+        offline path's prev_pos = plen init)."""
+        self._accept_ema[slot] = 1.0
+        self._k_eff[slot] = self.spec_k
+        self._spec_prev_tokens[slot] = 0
+        self._spec_prev_tokens[slot, 0] = first
+        self._spec_prev_idx[slot] = 0
+        self._spec_prev_start[slot] = true_len
 
     # ------------------------------------------------------------------
     # KV block streaming (ISSUE 12): the disaggregation transfer unit
@@ -1864,6 +2041,13 @@ class ServingEngine:
         self._temps[slot] = payload.sampling.temperature
         self._top_ks[slot] = payload.sampling.top_k
         self._top_ps[slot] = payload.sampling.top_p
+        if self.spec_k:
+            # the imported blocks carry no DRAFT K/V, so heads-mode
+            # proposals start cold here — acceptance suffers, tokens
+            # never do (the rejection kernel is lossless at any draft
+            # quality)
+            self._reset_spec_slot(slot, payload.generated[-1],
+                                  payload.true_len)
         if self._radix is not None:
             full = np.concatenate(
                 [payload.prompt,
@@ -2111,7 +2295,19 @@ class ServingEngine:
                              acceptance_rate=(
                                  round(st["accepted_tokens"]
                                        / st["draft_tokens"], 4)
-                                 if st["draft_tokens"] else None))
+                                 if st["draft_tokens"] else None),
+                             # learned-drafting identity (ISSUE 16):
+                             # which draft served this engine, and how
+                             # many hot-swaps it absorbed mid-serve
+                             spec_heads=self._spec_heads,
+                             draft_swaps=self.draft_swaps,
+                             draft_params_hash=self.draft_params_hash(),
+                             **(dict(accept_ema=round(
+                                         float(self._accept_ema.mean()),
+                                         4),
+                                     effective_k=round(
+                                         float(self._k_eff.mean()), 3))
+                                if self.adaptive_k else {}))
                         if self.spec_k else {})
                 per_block = self.kv_hbm_bytes // self.num_blocks
                 self.telemetry.pool(
@@ -2196,7 +2392,11 @@ class ServingEngine:
                     # kv_windows leaves) — a stale windowed executable from
                     # before ISSUE 15 would deserialize against the wrong
                     # donation layout, so the flag is part of the key
-                    f"pslot={int(self.per_slot_limits)}")
+                    f"pslot={int(self.per_slot_limits)};"
+                    # ISSUE 16: proposal heads change the draft tree and
+                    # the tick program; adaptive k adds the k_eff operand
+                    f"sheads={self._spec_heads};"
+                    f"adk={int(self.adaptive_k)}")
 
         def compile_fn():
             return jit_fn.lower(*statics, *args, **kw_statics).compile()
@@ -2375,6 +2575,86 @@ class ServingEngine:
         router's warmup re-admission probes it healthy again."""
         self._weights = params["params"] if "params" in params else params
 
+    def set_draft_params(self, params) -> None:
+        """Hot-swap the DRAFT weights mid-serving (ISSUE 16) — the
+        distill→swap→measure loop's serve-side handle. The new tree must
+        match the current draft's structure and leaf shapes exactly (the
+        draft ARCHITECTURE is baked into the compiled tick; only values
+        may move), which also guarantees no retrace: resident streams
+        keep ticking and their tokens never change — draft quality moves
+        ACCEPTANCE only, the rejection kernel is lossless either way
+        (greedy streams are bitwise-identical across the swap; tests pin
+        that mid-stream)."""
+        if not self.spec_k:
+            raise ValueError(
+                "set_draft_params on a non-speculative engine (spec_k "
+                "== 0): there is no draft to swap")
+        import flax.linen as nn
+
+        new = nn.meta.unbox(params["params"] if "params" in params
+                            else params)
+        old_leaves = jax.tree_util.tree_flatten_with_path(
+            self._draft_weights)
+        new_leaves = jax.tree_util.tree_flatten_with_path(new)
+        if old_leaves[1] != new_leaves[1]:
+            raise ValueError(
+                "draft param tree structure mismatch — a hot-swap may "
+                "only replace VALUES for the architecture the engine "
+                "compiled (same num_layers / spec_heads; rebuild the "
+                "engine to change the draft's shape)")
+        for (path, a), (_, b) in zip(old_leaves[0], new_leaves[0]):
+            if getattr(a, "shape", None) != getattr(b, "shape", None):
+                raise ValueError(
+                    f"draft param shape mismatch at "
+                    f"{jax.tree_util.keystr(path)}: engine has "
+                    f"{getattr(a, 'shape', None)}, swap brings "
+                    f"{getattr(b, 'shape', None)}")
+            if jnp.asarray(b).dtype != getattr(a, "dtype", None):
+                raise ValueError(
+                    f"draft param dtype mismatch at "
+                    f"{jax.tree_util.keystr(path)}: engine compiled "
+                    f"{getattr(a, 'dtype', None)}, swap brings "
+                    f"{jnp.asarray(b).dtype} — precision is baked into "
+                    f"the tick; a cast here would not be value-lossless")
+        # re-place each leaf to be cache-key-identical to the RESIDENT
+        # leaf: the pjit cache keys on sharding AND committedness, so a
+        # checkpoint restored under a trainer mesh (committed
+        # NamedSharding leaves vs the boot tree's uncommitted
+        # default-device ones) would silently retrace the tick — and
+        # the first post-swap step would stall a subprocess replica
+        # straight into the router's hang watchdog
+        def _like(b, a):
+            if not hasattr(a, "sharding"):
+                return jnp.asarray(b)
+            if getattr(a, "_committed", True):
+                return jax.device_put(b, a.sharding)
+            # uncommitted resident leaf: round-trip through host so the
+            # result is an uncommitted default-device array too
+            return jnp.asarray(np.asarray(b))
+
+        self._draft_weights = jax.tree.map(_like, new,
+                                           self._draft_weights)
+        self.draft_swaps += 1
+        self._draft_hash = None  # recomputed lazily on next read
+
+    def draft_params_hash(self) -> str | None:
+        """8-hex fingerprint of the CURRENT draft weights (None when
+        spec is off) — per-leaf fp32 sums hashed with the tree paths, so
+        a replica row can show WHICH draft it serves and a fleet
+        broadcast can be audited replica-by-replica without shipping
+        trees around. Computed lazily, cached until the next swap."""
+        if not self.spec_k:
+            return None
+        if getattr(self, "_draft_hash", None) is None:
+            h = hashlib.sha1()
+            for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    self._draft_weights):
+                h.update(jax.tree_util.keystr(path).encode())
+                h.update(np.float64(
+                    jnp.sum(jnp.asarray(leaf, jnp.float32))).tobytes())
+            self._draft_hash = h.hexdigest()[:8]
+        return self._draft_hash
+
     def invalidate_prefix_cache(self) -> None:
         """Drop every radix-cached prefix block (refcounts released; a
         block still referenced by a resident slot survives until that
@@ -2514,4 +2794,16 @@ class ServingEngine:
                 round(st["decode_tokens"] / st["target_forwards"], 3)
                 if st["target_forwards"] else None)
             out["draft_kv_hbm_bytes"] = self.draft_kv_hbm_bytes
+            # learned-drafting telemetry (ISSUE 16): which draft this
+            # engine serves (fingerprint + how many hot-swaps it has
+            # absorbed), the head-parallel flag, and — adaptive mode —
+            # the fleet-mean acceptance EMA and effective depth
+            out["spec_heads"] = self._spec_heads
+            out["adaptive_k"] = self.adaptive_k
+            out["draft_swaps"] = self.draft_swaps
+            out["draft_params_hash"] = self.draft_params_hash()
+            if self.adaptive_k:
+                out["accept_ema"] = round(
+                    float(self._accept_ema.mean()), 4)
+                out["effective_k"] = round(float(self._k_eff.mean()), 3)
         return out

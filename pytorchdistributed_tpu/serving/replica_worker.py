@@ -42,6 +42,19 @@ the persistent AOT executable cache (runtime/compile_cache.py; the
 PTD_COMPILE_CACHE env works too) — together they are what makes a
 router-respawned replica serve again in load-bound seconds instead of
 compile-bound minutes (ISSUE 10).
+
+Speculative drafts (ISSUE 16): ``spec["engine"]["draft"]`` = {"num_layers":
+<int|null>, "spec_heads": <int>, "checkpoint": <dir>, "checkpoint_step":
+<int>} builds the draft with ``inference.make_draft`` (truncating the
+TARGET's own restored weights, attaching zero-init proposal heads) and,
+when the draft checkpoint is present, hot-loads the distilled weights
+through the engine's verified ``set_draft_params`` path. The
+``set_draft_params`` wire op carries a CHECKPOINT PATH, never a weight
+tree: the worker restores it locally (CheckpointManager.restore_params —
+the same manifest-verified restore as boot) and the engine's
+structure/shape check decides; streams in flight keep their K/V and
+their token-for-token identity (the spec rejection kernel is lossless
+under ANY draft).
 """
 
 from __future__ import annotations
@@ -121,6 +134,27 @@ def _load_params(spec: dict, model):
         jnp.zeros((1, 8), jnp.int32))
 
 
+def _restore_draft_params(path, step=None):
+    """Verified params-only restore for a DRAFT weight tree (boot-time
+    ``draft.checkpoint`` and the ``set_draft_params`` wire op share it).
+    Raises on a missing/corrupt checkpoint — the engine-side structure
+    and shape check then decides whether the tree actually fits."""
+    import jax.numpy as jnp
+    import jax
+
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    mgr = CheckpointManager(path)
+    try:
+        params, ckpt_step = mgr.restore_params(step=step)
+    finally:
+        mgr.close()
+    # re-commit host-numpy leaves once, as _load_params does
+    return jax.tree.map(jnp.asarray, params), ckpt_step
+
+
 def _build_engine(spec: dict):
     from pytorchdistributed_tpu.models import (
         GPT2,
@@ -145,8 +179,33 @@ def _build_engine(spec: dict):
     engine_kwargs = dict(spec.get("engine", {}))
     if spec.get("compile_cache"):
         engine_kwargs.setdefault("compile_cache", spec["compile_cache"])
-    return ServingEngine(model, params, telemetry=telemetry,
-                         **engine_kwargs)
+    draft = engine_kwargs.pop("draft", None)
+    draft_ckpt = None
+    if draft:
+        from pytorchdistributed_tpu.inference import make_draft
+
+        draft_model, draft_params = make_draft(
+            model, params, num_layers=draft.get("num_layers"),
+            spec_heads=int(draft.get("spec_heads", 0)),
+            seed=int(draft.get("seed", 0)))
+        engine_kwargs.setdefault("draft_config", draft_model.cfg)
+        engine_kwargs.setdefault("draft_params", draft_params)
+        draft_ckpt = draft.get("checkpoint")
+    engine = ServingEngine(model, params, telemetry=telemetry,
+                           **engine_kwargs)
+    if draft_ckpt:
+        # distilled weights ride the SAME verified path as a later
+        # hot-swap — a bad draft checkpoint degrades to the warm-start
+        # draft (still lossless), it never kills the worker
+        try:
+            restored, _ = _restore_draft_params(
+                draft_ckpt, draft.get("checkpoint_step"))
+            engine.set_draft_params(restored)
+        except Exception as e:  # noqa: BLE001 — worker must still join
+            print(f"draft checkpoint {draft_ckpt} unusable "
+                  f"({type(e).__name__}: {e}); serving warm-start draft",
+                  file=sys.stderr)
+    return engine
 
 
 def main() -> int:
@@ -350,6 +409,21 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                 finished.append([op["rid"], "preempted"])
                 del reqs[op["rid"]]
             reply(ok=bool(ok), rid=op["rid"])
+        elif kind == "set_draft_params":
+            # fleet draft hot-swap (ISSUE 16): checkpoint-path payload,
+            # restored locally and verified by the engine's structure/
+            # shape check; in-flight spec streams keep their K/V and
+            # stay token-for-token identical (lossless under any draft)
+            try:
+                params, step = _restore_draft_params(
+                    op["checkpoint"], op.get("step"))
+                engine.set_draft_params(params)
+            except Exception as e:  # noqa: BLE001 — refusal, not death
+                reply(ok=False, error=f"{type(e).__name__}: {e}"[:300])
+                continue
+            reply(ok=True, step=step,
+                  draft_hash=engine.draft_params_hash(),
+                  draft_swaps=engine.draft_swaps)
         elif kind == "probe":
             reply(finite=engine.check_params_finite())
         elif kind == "drain":

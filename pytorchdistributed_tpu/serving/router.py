@@ -295,6 +295,25 @@ class InProcessReplica:
         elif kind == "replica_nan":
             self.poison_params()
 
+    def set_draft_params(self, params=None, *, checkpoint=None,
+                         step=None) -> dict:
+        """Hot-swap the engine's speculative draft weights (ISSUE 16).
+        In-process the tree is handed over directly (the router restores
+        a checkpoint once for the whole fleet); the engine's structure/
+        shape check is the gate. Returns the new draft identity."""
+        if params is None:
+            if checkpoint is None:
+                raise ValueError("pass params or checkpoint")
+            from pytorchdistributed_tpu.training.checkpoint import (
+                CheckpointManager,
+            )
+
+            with CheckpointManager(checkpoint) as mgr:
+                params, _ = mgr.restore_params(step=step)
+        self.engine.set_draft_params(params)
+        return {"draft_hash": self.engine.draft_params_hash(),
+                "draft_swaps": self.engine.draft_swaps}
+
     def poison_params(self) -> None:
         """NaN every inexact param leaf (engine.nan_params): outputs
         rot instantly, and only the params-finite tripwire can say
@@ -523,6 +542,31 @@ class SubprocessReplica:
             m.done, m.finish_reason = True, "preempted"
         self._on_token.pop(rr.id, None)
         return True
+
+    def set_draft_params(self, params=None, *, checkpoint=None,
+                         step=None) -> dict:
+        """Draft hot-swap over the wire (ISSUE 16): the payload is a
+        CHECKPOINT PATH, never a weight tree — the worker restores it
+        locally through the same manifest-verified path as its boot
+        weights, and the engine's structure/shape check accepts or
+        refuses. Synchronous roundtrip (rare, like a handoff); a
+        refusal raises ValueError with the worker's reason."""
+        if checkpoint is None:
+            raise ValueError(
+                "subprocess replicas take set_draft_params(checkpoint=...)"
+                " — weight trees do not cross the wire")
+        self._drain_wire()
+        self._send({"op": "set_draft_params",
+                    "checkpoint": str(checkpoint),
+                    "step": step})
+        resp = self.wait_response(max(self.hang_grace_s, 60.0))
+        self._pending_op = None
+        if resp.get("ok") is not True:
+            raise ValueError(
+                f"replica {self.index}: set_draft_params refused: "
+                f"{resp.get('error')}")
+        return {"draft_hash": resp.get("draft_hash"),
+                "draft_swaps": int(resp.get("draft_swaps", 0))}
 
     # -- KV block stream (ISSUE 12) -----------------------------------
     # Handoffs are synchronous wire roundtrips by design: the payload
@@ -952,6 +996,10 @@ class ReplicaRouter:
         self._placements = [0 for _ in self._replicas]
         self._ticks = 0
         self._draining = False
+        # per-replica draft identity after a hot-swap (ISSUE 16):
+        # {index: {"draft_hash", "draft_swaps"}} — survives reset_stats
+        # (identity is state, not a counter)
+        self._draft_info: dict[int, dict] = {}
         self._recovering: list[dict] = []
         self._occ_sum = [0.0 for _ in self._replicas]
         self._occ_n = [0 for _ in self._replicas]
@@ -1235,6 +1283,9 @@ class ReplicaRouter:
             return
         self._status[r.index] = DEAD
         self._prefix_index.remove(r.index)
+        # a respawn reboots from the SPEC's draft (if any) — the swapped
+        # identity died with the process
+        self._draft_info.pop(r.index, None)
         self._stats["replicas_lost"] += 1
         if why == "hung":
             self._stats["hangs_detected"] += 1
@@ -2082,6 +2133,75 @@ class ReplicaRouter:
             self.max_seq_len = min([self.max_seq_len] + reported)
         self.reset_stats()
 
+    def set_draft_params(self, params=None, *, checkpoint=None,
+                         step=None) -> dict[int, dict]:
+        """Broadcast a speculative-draft hot-swap to the whole fleet
+        (ISSUE 16) — the serve half of the distill→swap loop: a
+        DistillTrainer checkpoint becomes every replica's draft without
+        dropping a stream (spec decode is lossless under ANY draft, so
+        in-flight requests keep their token-for-token identity and their
+        K/V; only the acceptance rate moves).
+
+        In-process fleets accept a weight tree directly, or restore
+        ``checkpoint`` ONCE and share the host copy; subprocess fleets
+        require ``checkpoint`` — the PATH crosses the wire and each
+        worker restores it through the same manifest-verified loader as
+        its boot weights. Per-replica verification (tree structure +
+        leaf shapes) happens in the engine either way.
+
+        Returns {replica_index: {"draft_hash", "draft_swaps"}} for the
+        replicas that accepted. A refusal (architecture mismatch) is
+        counted, evented, and skipped — unless EVERY live replica
+        refuses, which raises (the swap was simply wrong)."""
+        if self._worker_specs is not None:
+            if checkpoint is None:
+                raise ValueError(
+                    "a subprocess fleet takes set_draft_params("
+                    "checkpoint=...) — weight trees do not cross the "
+                    "wire")
+            params = None   # the path is the payload
+        elif params is None:
+            if checkpoint is None:
+                raise ValueError("pass params or checkpoint")
+            from pytorchdistributed_tpu.training.checkpoint import (
+                CheckpointManager,
+            )
+
+            # restore once, share the host copy fleet-wide
+            with CheckpointManager(checkpoint) as mgr:
+                params, _ = mgr.restore_params(step=step)
+        results: dict[int, dict] = {}
+        errors: list[str] = []
+        for r in self._replicas:
+            if self._status[r.index] in (DEAD, REMOVED):
+                continue
+            try:
+                if params is not None:
+                    info = r.set_draft_params(params)
+                else:
+                    info = r.set_draft_params(checkpoint=checkpoint,
+                                              step=step)
+            except (ReplicaCrashed, TimeoutError):
+                self._declare_dead(r, "crashed")
+                continue
+            except ValueError as e:
+                errors.append(f"replica {r.index}: {e}")
+                self._event("draft_swap_failed", replica=r.index,
+                            error=str(e)[:200])
+                continue
+            results[r.index] = info
+            self._draft_info[r.index] = info
+            self._stats["draft_swaps"] += 1
+            self._event("draft_swap", replica=r.index,
+                        hash=info.get("draft_hash"),
+                        swaps=info.get("draft_swaps"),
+                        checkpoint=(str(checkpoint) if checkpoint
+                                    else None))
+        if errors and not results:
+            raise ValueError("draft swap refused fleet-wide: "
+                             + "; ".join(errors[:3]))
+        return results
+
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         while self._queue or any(self._assigned[r.index]
                                  for r in self._replicas):
@@ -2225,6 +2345,7 @@ class ReplicaRouter:
                            handoffs=0, handoff_failures=0,
                            prefix_ships=0, kv_stream_bytes=0,
                            scale_ups=0, scale_downs=0,
+                           draft_swaps=0,
                            preemptions=0, preempted_requeues=0,
                            tenants={},
                            served_by={}, ttft_s=[],
@@ -2296,6 +2417,7 @@ class ReplicaRouter:
             "respawn_failures": st["respawn_failures"],
             "scale_ups": st["scale_ups"],
             "scale_downs": st["scale_downs"],
+            "draft_swaps": st["draft_swaps"],
             "preemptions": st["preemptions"],
             "preempted_requeues": st["preempted_requeues"],
             "statuses": list(self._status),
@@ -2333,6 +2455,13 @@ class ReplicaRouter:
                 float(np.percentile(ttfts, 50)) * 1e3, 3)
             out["ttft_ms_p99"] = round(
                 float(np.percentile(ttfts, 99)) * 1e3, 3)
+        if self._draft_info:
+            # per-replica draft identity (hash + lifetime swap count):
+            # the report CLI's proof that the fleet converged on ONE
+            # distilled draft after a broadcast
+            out["draft"] = {
+                i: dict(info)
+                for i, info in sorted(self._draft_info.items())}
         if st["tenants"]:
             adm = (self._admission.tenant_stats()
                    if self._admission is not None else {})

@@ -264,9 +264,23 @@ def _serving_section(run_dir: str) -> list[str]:
         if any(r.get("spec_k") for r in pools):
             drafted = sum(r.get("draft_tokens") or 0 for r in pools)
             accepted = sum(r.get("accepted_tokens") or 0 for r in pools)
+            # learned-drafting identity (ISSUE 16): which draft served
+            # this engine (params fingerprint + hot-swap count, proposal
+            # heads), and — adaptive-k runs — the close-time acceptance
+            # EMA and the effective depth it settled at
+            heads = p.get("spec_heads")
+            extra = f", {heads} proposal heads" if heads else ""
+            if p.get("draft_params_hash"):
+                extra += f", draft {p['draft_params_hash']}"
+            swaps = sum(r.get("draft_swaps") or 0 for r in pools)
+            if swaps:
+                extra += f" ({swaps} hot-swaps)"
+            if p.get("accept_ema") is not None:
+                extra += (f", accept ema {p['accept_ema']:.2f}"
+                          f" -> k_eff {p.get('effective_k', '-')}")
             lines.append(
                 f"  speculation: k={p.get('spec_k')}, "
-                f"{accepted}/{drafted} draft tokens accepted")
+                f"{accepted}/{drafted} draft tokens accepted{extra}")
     return lines
 
 
@@ -303,7 +317,9 @@ def _router_section(run_dir: str) -> list[str]:
                 f"quarantines {summary.get('quarantines', 0)}  "
                 f"rejoins {summary.get('rejoins', 0)}  "
                 f"respawns {summary.get('respawns', 0)}"
-                + (f"  recovery {rec} ticks" if rec is not None else ""))
+                + (f"  recovery {rec} ticks" if rec is not None else "")
+                + (f"  draft_swaps {summary.get('draft_swaps')}"
+                   if summary.get("draft_swaps") else ""))
             if (summary.get("handoffs") or summary.get("prefix_ships")
                     or summary.get("cross_replica_hit_rate")):
                 # the disaggregation line (ISSUE 12): KV handoff +
@@ -325,11 +341,20 @@ def _router_section(run_dir: str) -> list[str]:
         served = {int(k): v for k, v in
                   ((summary or {}).get("served_by") or {}).items()}
         roles = (summary or {}).get("roles") or []
+        # per-replica draft identity (ISSUE 16): the summary's ``draft``
+        # map is close-time truth (params fingerprint + lifetime swap
+        # count); the draft_swap event trail backs it when a replica
+        # died (its map entry is popped) after absorbing a swap
+        draft_map = {int(k): v for k, v in
+                     ((summary or {}).get("draft") or {}).items()}
+        draft_on = bool(draft_map) or any(
+            e.get("event", "").startswith("draft_swap") for e in events)
+        draft_hdr = (f"  {'draft':>8}  {'swaps':>5}" if draft_on else "")
         lines.append(f"  {'replica':>7}  {'role':>7}  {'status':>11}  "
                      f"{'served':>6}  "
                      f"{'occupancy':>9}  {'failovers':>9}  "
                      f"{'quarantines':>11}  {'rejoins':>7}  "
-                     f"{'respawns':>8}  {'handoffs':>8}")
+                     f"{'respawns':>8}  {'handoffs':>8}{draft_hdr}")
         for i in range(n_replicas or 0):
             status = next((s.get("status", "-") for s in reversed(samples)
                            if s.get("replica") == i), "-")
@@ -352,10 +377,26 @@ def _router_section(run_dir: str) -> list[str]:
                        and i in (e.get("from_replica"),
                                  e.get("to_replica")))
             o = occ[i] if i < len(occ) and occ[i] is not None else None
+            if draft_on:
+                d = draft_map.get(i)
+                if d is None:
+                    # dead/swapped-out replica: fall back to its last
+                    # draft_swap event so the trail stays readable
+                    last = next((e for e in reversed(events)
+                                 if e.get("event") == "draft_swap"
+                                 and e.get("replica") == i), None)
+                    d = (dict(draft_hash=last.get("hash"),
+                              draft_swaps=last.get("swaps"))
+                         if last else {})
+                draft_col = (f"  {d.get('draft_hash') or '-':>8}  "
+                             f"{d.get('draft_swaps', 0) or 0:>5}")
+            else:
+                draft_col = ""
             lines.append(
                 f"  {i:>7}  {role:>7}  {status:>11}  {served.get(i, 0):>6}  "
                 f"{(f'{o:.2%}' if o is not None else '-'):>9}  "
-                f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}  {hoff:>8}")
+                f"{lost:>9}  {quar:>11}  {rej:>7}  {resp:>8}  {hoff:>8}"
+                f"{draft_col}")
         tens = (summary or {}).get("tenants") or {}
         if tens:
             # the multi-tenant admission table (ISSUE 15): per-tenant

@@ -1,4 +1,9 @@
 from pytorchdistributed_tpu.training.trainer import Trainer, TrainState  # noqa: F401
+from pytorchdistributed_tpu.training.distill import (  # noqa: F401
+    DistillTrainer,
+    distill_corpus,
+    distill_loss,
+)
 from pytorchdistributed_tpu.training.losses import (  # noqa: F401
     cross_entropy_loss,
     fused_token_cross_entropy_loss,

@@ -1,0 +1,271 @@
+"""Draft distillation (ISSUE 16): train a cheap speculative draft on
+KL-to-target-logits over a logged-traffic corpus.
+
+PR 8's speculative decode is lossless with ANY draft — quality only moves
+the acceptance rate, and with it the decode-rate multiplier. This module
+closes the learning half of that loop with three pieces that reuse the
+existing machinery unchanged:
+
+  * ``distill_loss`` — KL(teacher || student) per position, per proposal
+    offset: the base head matches the teacher's next-token distribution
+    at the same position, proposal head j (the Medusa recipe, Cai et al.
+    2024) matches the teacher's distribution j positions AHEAD — the
+    teacher-forced shifted target that one teacher forward yields for
+    every head at once. A standard Trainer ``loss_fn`` signature, so the
+    whole Trainer loop (accum, checkpointing, telemetry, diagnostics,
+    fault tolerance) rides along untouched.
+  * ``distill_corpus`` — batches from serving/traffic.py's deterministic
+    trace generator: the student trains on the prompt/length mix the
+    fleet actually serves, continued BY the target (the behavior being
+    distilled), with the teacher's log-probs precomputed once per batch.
+  * ``DistillTrainer`` — the thin wrapper: builds the student via
+    inference.make_draft (truncated-draft warm start for the block
+    weights, zero-init proposal heads), swaps the warm start into the
+    Trainer's freshly-initialized state, and hands back
+    ``(draft_config, draft_params)`` ready for ServingEngine /
+    ``router.set_draft_params`` hot-swap.
+
+The TARGET is frozen by construction, not by optimizer masking: its
+params are only ever READ (warm start + corpus teacher); the Trainer
+only ever sees the student.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pytorchdistributed_tpu.training.losses import (
+    _apply_collecting,
+    _diag_extras,
+    _stochastic_kwargs,
+)
+from pytorchdistributed_tpu.training.trainer import Trainer
+
+
+def distill_loss(model, params, batch, rng=None, *, diagnostics=False):
+    """KL(teacher || student) over every proposal offset in one forward.
+
+    batch = {tokens [b, s] int32,
+             target_logprobs [b, s, V] fp32 — the teacher's log-softmax
+               at every position (position i predicts token i+1),
+             loss_mask [b, s] optional — 1 where the teacher row is a
+               real prediction position}.
+
+    A student with ``cfg.spec_heads == H > 0`` runs ``spec_logits`` —
+    [b, s, H+1, V], index 0 the base head — and offset o trains
+    position i against the teacher at position i+o (the token i+o+1
+    both are predicting). H == 0 degrades to plain next-token
+    distillation. The scalar loss is the masked mean over ALL
+    (position, offset) pairs; metrics carry the per-offset means so a
+    distill run shows which head is lagging. Full-vocab teacher rows
+    are CPU-sized-corpus honest; a production-vocab corpus would ship
+    top-k + tail mass instead (same loss shape).
+    """
+    H = int(getattr(model.cfg, "spec_heads", 0))
+    if H:
+        method = type(model).spec_logits
+        out, mods = _apply_collecting(
+            model, params, batch["tokens"], diagnostics=diagnostics,
+            method=method, **_stochastic_kwargs(method, rng))
+    else:
+        out, mods = _apply_collecting(
+            model, params, batch["tokens"], diagnostics=diagnostics,
+            **_stochastic_kwargs(type(model).__call__, rng))
+        out = out[..., None, :]
+    tlp = batch["target_logprobs"].astype(jnp.float32)    # [b, s, V]
+    tp = jnp.exp(tlp)
+    s = tlp.shape[1]
+    mask = batch.get("loss_mask")
+    base_m = (jnp.ones(tlp.shape[:2], jnp.float32) if mask is None
+              else mask.astype(jnp.float32))
+    total = jnp.float32(0.0)
+    count = jnp.float32(0.0)
+    metrics = {}
+    for o in range(H + 1):
+        # student at position i (head o) vs teacher at position i + o
+        slp = jax.nn.log_softmax(
+            out[:, :s - o, o, :].astype(jnp.float32), axis=-1)
+        kl = (tp[:, o:] * (tlp[:, o:] - slp)).sum(-1)     # [b, s - o]
+        m = base_m[:, o:]
+        # where, not bare multiply: non-finite KL at a masked position
+        # (padding garbage) must drop, and inf * 0.0 is NaN
+        kl = jnp.where(m > 0, kl, 0.0)
+        total = total + (kl * m).sum()
+        count = count + m.sum()
+        name = "kl_base" if o == 0 else f"kl_head{o}"
+        metrics[name] = (kl * m).sum() / jnp.maximum(m.sum(), 1.0)
+    loss = total / jnp.maximum(count, 1.0)
+    # _mask_count: the grad-accumulation weight, exactly the
+    # _token_loss_reduce contract (losses.py) — masked micro-batches
+    # must reproduce the full-batch masked mean
+    return loss, {"loss": loss, "_mask_count": count, **metrics,
+                  **_diag_extras(mods, diagnostics)}
+
+
+def distill_corpus(model, params, *, seed: int = 0, num_batches: int = 8,
+                   batch_size: int = 8, seq_len: int = 64,
+                   max_new_tokens: int = 16, base_qps: float = 64.0,
+                   prompt_cap: int | None = None):
+    """Logged-traffic distillation batches: ``num_batches`` lists of
+    {tokens, target_logprobs, loss_mask}, deterministic per ``seed``.
+
+    Prompts come from serving/traffic.py's trace generator (the same
+    length/arrival mix the replay harness drives at the fleet), each
+    continued by the TARGET with greedy decode — the student distills
+    the behavior the fleet actually emits, not held-out text — and the
+    teacher's per-position log-probs come from ONE batched target
+    forward per corpus batch. Rows are right-padded to ``seq_len`` with
+    the pad masked out (and the final real token, which predicts
+    nothing)."""
+    from pytorchdistributed_tpu.inference import generate_bucketed
+    from pytorchdistributed_tpu.serving.traffic import make_trace
+
+    cfg = model.cfg
+    if seq_len > cfg.max_seq_len:
+        raise ValueError(
+            f"seq_len {seq_len} > model max_seq_len {cfg.max_seq_len}")
+    cap = prompt_cap or max(4, seq_len - max_new_tokens)
+    if cap + max_new_tokens > seq_len:
+        raise ValueError(
+            f"prompt_cap {cap} + max_new_tokens {max_new_tokens} "
+            f"exceeds seq_len {seq_len}")
+    need = num_batches * batch_size
+    trace = make_trace(
+        seed=seed, duration_s=need / base_qps * 1.5 + 1.0,
+        base_qps=base_qps, vocab_size=cfg.vocab_size,
+        prompt_cap=cap, new_cap=max_new_tokens)
+    if len(trace) < need:
+        raise ValueError(
+            f"trace yielded {len(trace)} requests < {need} needed — "
+            f"raise base_qps or lower num_batches x batch_size")
+    weights = params["params"] if "params" in params else params
+    dec = (model if cfg.decode
+           else model.clone(cfg=dataclasses.replace(cfg, decode=True)))
+    teacher = (model if not cfg.decode
+               else model.clone(cfg=dataclasses.replace(cfg, decode=False)))
+
+    @jax.jit
+    def teacher_logprobs(w, toks):
+        logits = teacher.apply({"params": w}, toks).astype(jnp.float32)
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    batches = []
+    reqs = trace[:need]
+    for b in range(num_batches):
+        rows = np.zeros((batch_size, seq_len), np.int32)
+        mask = np.zeros((batch_size, seq_len), np.float32)
+        for i, req in enumerate(reqs[b * batch_size:(b + 1) * batch_size]):
+            prompt = req.prompt[None]
+            out = np.asarray(generate_bucketed(
+                dec, {"params": weights}, jnp.asarray(prompt),
+                max_new_tokens=min(req.max_new_tokens, max_new_tokens)))
+            row = out[0][:seq_len]
+            rows[i, :row.size] = row
+            mask[i, :row.size - 1] = 1.0  # last token predicts nothing
+        tlp = np.asarray(teacher_logprobs(weights, jnp.asarray(rows)))
+        batches.append({"tokens": rows, "target_logprobs": tlp,
+                        "loss_mask": mask})
+    return batches
+
+
+class DistillTrainer:
+    """Trainer wrapper that distills a speculative draft from a frozen
+    target (ISSUE 16). Construction mirrors inference.make_draft:
+    ``num_layers`` truncates the target's block stack (the free warm
+    start), ``spec_heads`` attaches zero-init multi-token proposal
+    heads; the student then trains under the UNCHANGED Trainer — every
+    trainer_kwarg (checkpoint_dir, telemetry_dir, diagnostics, strategy,
+    accum_steps ...) works exactly as on a full model, because the
+    Trainer cannot tell the difference.
+
+    Usage::
+
+        dt = DistillTrainer(target, params, num_layers=1, spec_heads=3,
+                            checkpoint_dir=ckpt)
+        corpus = distill_corpus(target, params, seed=0)
+        dt.init(corpus[0])
+        for epoch in range(epochs):
+            for batch in corpus:
+                dt.train_step(batch)
+        draft_config, draft_params = dt.draft()   # -> ServingEngine /
+                                                  #    set_draft_params
+    """
+
+    def __init__(self, model, params, *, num_layers: int | None = None,
+                 spec_heads: int = 0, optimizer=None, seed: int = 0,
+                 **trainer_kwargs):
+        from pytorchdistributed_tpu.inference import make_draft
+
+        draft, dparams = make_draft(model, params, num_layers=num_layers,
+                                    spec_heads=spec_heads, seed=seed)
+        #: the SERVE-time draft config (inherits the target's decode
+        #: knobs) — what ServingEngine(draft_config=...) wants
+        self.draft_config = draft.cfg
+        # the student trains decode-OFF: no cache collection in its
+        # train-time tree, full-sequence forwards
+        self.student = draft.clone(cfg=dataclasses.replace(
+            draft.cfg, decode=False))
+        # callers may hand boxed (LogicallyPartitioned) init output —
+        # the Trainer state is unboxed, so the warm graft must be too
+        self._warm = nn.meta.unbox(dparams["params"])
+        if optimizer is None:
+            optimizer = optax.adamw(1e-3)
+        self.trainer = Trainer(self.student, optimizer, distill_loss,
+                               **trainer_kwargs)
+
+    def init(self, sample_batch, seed: int = 0):
+        """Trainer.init, then the warm start (truncated target blocks +
+        zero-init heads) swapped over the fresh params — optimizer
+        moments stay zero-init, which is exactly right for a warm
+        start."""
+        state = self.trainer.init(sample_batch, seed)
+        # state.params keeps the collection wrapper ({"params": ...}, plus
+        # batch_stats when present) — graft the warm tree over just the
+        # "params" collection, onto the Trainer's shardings
+        grafted = dict(state.params)
+        # jnp.copy, not the arrays themselves: the warm tree aliases the
+        # CALLER's target params (make_draft shares embed/ln_f leaves), and
+        # the donated train step would free them through the alias —
+        # device_put alone is an identity when the sharding already matches
+        grafted["params"] = jax.tree.map(jnp.copy, self._warm)
+        warm = jax.device_put(grafted, self.trainer.state_shardings.params)
+        self.trainer.state = state.replace(params=warm)
+        return self.trainer.state
+
+    # -- Trainer passthroughs (the wrapper adds nothing to the loop) ----
+
+    @property
+    def state(self):
+        return self.trainer.state
+
+    @property
+    def checkpoint(self):
+        return self.trainer.checkpoint
+
+    def train_step(self, batch):
+        return self.trainer.train_step(batch)
+
+    def fit(self, loader, max_epochs: int, **kw):
+        return self.trainer.fit(loader, max_epochs, **kw)
+
+    def restore(self, *a, **kw):
+        return self.trainer.restore(*a, **kw)
+
+    def evaluate(self, loader):
+        return self.trainer.evaluate(loader)
+
+    def draft(self):
+        """(draft_config, draft_params) at the CURRENT step — drop
+        straight into ServingEngine(spec_k=..., draft_config=...,
+        draft_params=...) or engine/router ``set_draft_params`` (the
+        hot-swap path; architecture matches by construction)."""
+        # state.params already carries the collection wrapper
+        # ({"params": ...}); device_get also severs aliasing with the
+        # trainer's donated state
+        return self.draft_config, jax.device_get(self.trainer.state.params)

@@ -1,0 +1,298 @@
+"""Draft distillation tests (ISSUE 16): the distill→swap→measure loop.
+
+Layers under test:
+  * distill_loss / distill_corpus / DistillTrainer — KL falls under
+    training, the TARGET stays frozen (read-only by construction), and
+    the trained student round-trips through the Trainer's checkpoint
+    into the exact tree the serving engine's hot-swap accepts;
+  * the fleet broadcast — ReplicaRouter.set_draft_params swaps every
+    replica mid-stream with streams bitwise-equal to generate()
+    (losslessness is independent of draft quality), the per-replica
+    draft identity (params fingerprint + swap count) lands in
+    summary()/telemetry/report, and — full tier — the same loop over
+    SUBPROCESS workers via the checkpoint-path wire op, leaving no
+    orphan processes behind.
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.inference import generate, generate_speculative
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import ServingEngine
+from pytorchdistributed_tpu.serving.router import ReplicaRouter
+from pytorchdistributed_tpu.training import (
+    DistillTrainer,
+    distill_corpus,
+)
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+def _target(seed=1):
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(seed), jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _trained_draft(model, params, *, steps=2, checkpoint_dir=None,
+                   spec_heads=3):
+    corpus = distill_corpus(model, params, seed=0, num_batches=1,
+                            batch_size=8, seq_len=48, max_new_tokens=8)
+    dt = DistillTrainer(model, params, num_layers=1,
+                        spec_heads=spec_heads,
+                        checkpoint_dir=checkpoint_dir)
+    dt.init(corpus[0])
+    metrics = [dt.train_step(corpus[0]) for _ in range(steps)]
+    return dt, metrics
+
+
+def test_distill_loss_falls_and_target_stays_frozen():
+    """KL(teacher || student) falls under training, per-offset metrics
+    surface, and the CALLER's target tree is bitwise-untouched — the
+    teacher is frozen by construction, not by optimizer masking."""
+    model, params = _target()
+    before = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+    corpus = distill_corpus(model, params, seed=0, num_batches=2,
+                            batch_size=8, seq_len=48, max_new_tokens=8)
+    dt = DistillTrainer(model, params, num_layers=1, spec_heads=2)
+    dt.init(corpus[0])
+    first = last = None
+    for _ in range(8):
+        for b in corpus:
+            m = dt.train_step(b)
+            if first is None:
+                first = float(m["loss"])
+    last = float(m["loss"])
+    assert last < first, (first, last)
+    assert "kl_base" in m and "kl_head1" in m and "kl_head2" in m
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(before),
+            jax.tree_util.tree_leaves_with_path(params)):
+        np.testing.assert_array_equal(
+            a, np.asarray(b), err_msg=jax.tree_util.keystr(ka))
+
+
+def test_distill_corpus_deterministic_and_validated():
+    model, params = _target()
+    a = distill_corpus(model, params, seed=3, num_batches=1, batch_size=2,
+                       seq_len=32, max_new_tokens=4)
+    b = distill_corpus(model, params, seed=3, num_batches=1, batch_size=2,
+                       seq_len=32, max_new_tokens=4)
+    np.testing.assert_array_equal(a[0]["tokens"], b[0]["tokens"])
+    np.testing.assert_array_equal(a[0]["target_logprobs"],
+                                  b[0]["target_logprobs"])
+    with pytest.raises(ValueError, match="max_seq_len"):
+        distill_corpus(model, params, seq_len=128)
+    with pytest.raises(ValueError, match="prompt_cap"):
+        distill_corpus(model, params, seq_len=32, max_new_tokens=16,
+                       prompt_cap=30)
+
+
+def test_distilled_draft_offline_bitwise():
+    """Losslessness survives a TRAINED draft: generate_speculative with
+    the distilled student (heads on) is bitwise-equal to generate()."""
+    model, params = _target()
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    dt, _ = _trained_draft(model, params)
+    dcfg, dparams = dt.draft()
+    draft = GPT2(dcfg)
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 9)),
+                         jnp.int32)
+    ref = generate(dm, params, prompt, max_new_tokens=12)
+    out = generate_speculative(dm, params, prompt, max_new_tokens=12,
+                               spec_k=4, draft_model=draft,
+                               draft_params=dparams)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_distill_checkpoint_roundtrip_feeds_hot_swap(tmp_path):
+    """The Trainer checkpoint the distiller writes restores into the
+    exact tree the engine hot-swap accepts — the wire contract of the
+    router's checkpoint-path broadcast."""
+    from pytorchdistributed_tpu.serving.replica_worker import (
+        _restore_draft_params,
+    )
+
+    model, params = _target()
+    dt, _ = _trained_draft(model, params,
+                           checkpoint_dir=str(tmp_path / "draft"))
+    dt.checkpoint.save(int(dt.state.step), dt.state, force=True)
+    dt.checkpoint.wait()
+    restored, step = _restore_draft_params(str(tmp_path / "draft"))
+    assert step == int(dt.state.step)
+    dcfg, dparams = dt.draft()
+    engine = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                           block_size=8, spec_k=4, draft_config=dcfg,
+                           draft_params=dparams)
+    hash_live = engine.draft_params_hash()
+    engine.set_draft_params(restored)
+    assert engine.draft_swaps == 1
+    # the restored tree IS the live tree — same fingerprint
+    assert engine.draft_params_hash() == hash_live
+    engine.close()
+
+
+def test_router_inprocess_fleet_swap_midstream_bitwise(tmp_path):
+    """One router call swaps EVERY replica's draft mid-stream: resident
+    streams finish bitwise vs generate(), both replicas report the same
+    new fingerprint, and the draft identity lands in the summary map,
+    the telemetry events, and the report CLI's replica table.
+
+    Tier-1 anchor: the swapped tree is a same-structure perturbation —
+    it exercises the broadcast/identity/bitwise contract without paying
+    distill_corpus's teacher-generate compile; the DistillTrainer-
+    produced tree drives the same swap in the full-tier checkpoint
+    round-trip and subprocess e2e tests."""
+    from pytorchdistributed_tpu.inference import make_draft
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    model, params = _target()
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=3)
+    dparams = jax.tree.map(lambda x: x * 0.5, dp)
+    router = ReplicaRouter(
+        workers=None, replicas=2, model=model, params=params,
+        engine_kwargs=dict(num_slots=2, prefill_bucket=16, block_size=8,
+                           spec_k=4, draft_config=draft.cfg,
+                           draft_params=dp, adaptive_k=True),
+        telemetry_dir=str(tmp_path))
+    rng = np.random.default_rng(9)
+    lens, news = (7, 11, 5, 9), (12, 9, 14, 10)
+    prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+               for m in lens]
+    reqs = [router.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    for _ in range(2):
+        router.step()
+    info = router.set_draft_params(params=dparams)
+    assert set(info) == {0, 1}
+    assert len({v["draft_hash"] for v in info.values()}) == 1
+    assert all(v["draft_swaps"] == 1 for v in info.values())
+    router.run_until_idle()
+    for p, n, r in zip(prompts, news, reqs):
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=n)
+        np.testing.assert_array_equal(r.output_ids, np.asarray(ref)[0],
+                                      err_msg=f"request {r.id}")
+    s = router.summary()
+    assert s["draft_swaps"] == 2
+    assert set(s["draft"]) == {0, 1}
+    the_hash = s["draft"][0]["draft_hash"]
+    router.close()
+    report = render(str(tmp_path))
+    assert the_hash in report
+    assert "draft_swaps 2" in report
+
+
+def test_router_refuses_mismatched_draft_fleet_wide():
+    """A wrong-architecture broadcast is refused by EVERY replica and
+    the fleet keeps serving on its old draft."""
+    from pytorchdistributed_tpu.inference import make_draft
+
+    model, params = _target()
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=3)
+    _, wrong = make_draft(dm, params, num_layers=1, spec_heads=1)
+    router = ReplicaRouter(
+        workers=None, replicas=2, model=model, params=params,
+        engine_kwargs=dict(num_slots=2, prefill_bucket=16, block_size=8,
+                           spec_k=4, draft_config=draft.cfg,
+                           draft_params=dp))
+    with pytest.raises(ValueError, match="structure"):
+        router.set_draft_params(params=wrong)
+    assert router.summary()["draft_swaps"] == 0
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# full tier: subprocess fleet + the example (spawn jax-importing workers)
+
+
+def _worker_spec(tmp_path, model, params, draft_ckpt=None):
+    from pytorchdistributed_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    tgt = str(tmp_path / "target")
+    with CheckpointManager(tgt) as mgr:
+        mgr.save(7, {"step": jnp.int32(7), "params": params,
+                     "opt_state": {"nu": jnp.zeros(3)}})
+    return {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "checkpoint": tgt,
+            "engine": {"num_slots": 3, "prefill_bucket": 16,
+                       "block_size": 8, "spec_k": 4, "adaptive_k": True,
+                       "draft": {"num_layers": 1, "spec_heads": 3}}}
+
+
+def test_router_subprocess_hot_swap_checkpoint_no_orphans(tmp_path):
+    """The wire op end-to-end: a 2-subprocess fleet swaps to a distilled
+    checkpoint mid-stream without dropping or retracing a stream
+    (replicas_lost must stay 0 — a post-swap retrace would stall a
+    worker into the hang watchdog), streams stay bitwise, params-only
+    broadcasts are refused (trees never ship over the wire), and close()
+    leaves no orphan worker processes."""
+    model, params = _target()
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    spec = _worker_spec(tmp_path, model, params)
+    dt, _ = _trained_draft(model, params,
+                           checkpoint_dir=str(tmp_path / "draft"))
+    dt.checkpoint.save(int(dt.state.step), dt.state, force=True)
+    dt.checkpoint.wait()
+
+    router = ReplicaRouter(workers=[spec, spec], warmup_lens=(16,),
+                           faults=None, telemetry_dir=str(tmp_path))
+    procs = [rep.proc for rep in router._replicas]
+    try:
+        rng = np.random.default_rng(9)
+        lens, news = (7, 11, 5, 9), (20, 18, 22, 16)
+        prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+                   for m in lens]
+        reqs = [router.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        for _ in range(4):
+            router.step()
+        with pytest.raises(ValueError, match="checkpoint"):
+            router.set_draft_params(params=dt.draft()[1])
+        info = router.set_draft_params(
+            checkpoint=str(tmp_path / "draft"))
+        assert set(info) == {0, 1}
+        assert len({v["draft_hash"] for v in info.values()}) == 1
+        router.run_until_idle()
+        for p, n, r in zip(prompts, news, reqs):
+            ref = generate(dm, params, jnp.asarray(p)[None],
+                           max_new_tokens=n)
+            np.testing.assert_array_equal(
+                r.output_ids, np.asarray(ref)[0],
+                err_msg=f"request {r.id}")
+        s = router.summary()
+        assert s["replicas_lost"] == 0 and s["failovers"] == 0
+        assert s["draft_swaps"] == 2
+    finally:
+        router.close()
+    for p in procs:
+        assert p.poll() is not None, "orphan worker process after close"
+
+
+def test_example_distill_draft_runs():
+    """The end-to-end demo (train target → distill → serve → hot-swap)
+    runs clean and prints its acceptance A/B."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples",
+                                      "distill_draft.py")],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "acceptance" in out.stdout
+    assert "hot-swap" in out.stdout

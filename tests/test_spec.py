@@ -499,3 +499,164 @@ def test_engine_spec_telemetry_rows(tmp_path):
     assert any("accepted_tokens" in r for r in ticks)
     report = render(str(tmp_path))
     assert "acc rate" in report and "100.00%" in report
+
+
+# ---------------------------------------------------------------------------
+# learned drafting (ISSUE 16): proposal heads, adaptive k, draft hot-swap
+
+
+def test_offline_greedy_bitwise_proposal_heads():
+    """Medusa-style proposal heads: ONE draft forward proposes the whole
+    k-token window, and rejection keeps the stream bitwise-equal to
+    generate() — losslessness is independent of what the heads emit."""
+    from pytorchdistributed_tpu.inference import make_draft
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=3)
+    assert draft.cfg.spec_heads == 3
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)),
+                         jnp.int32)
+    ref = generate(dm, params, prompt, max_new_tokens=12)
+    out = generate_speculative(dm, params, prompt, max_new_tokens=12,
+                               spec_k=4, draft_model=draft,
+                               draft_params=dp)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_make_draft_validations():
+    from pytorchdistributed_tpu.inference import make_draft
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(dataclasses.replace(cfg, decode=True))
+    params = _init(GPT2(cfg))
+    with pytest.raises(ValueError, match="spec_heads"):
+        make_draft(model, params, spec_heads=-1)
+    # num_layers None / equal keeps the full stack (self-draft-sized)
+    d, _ = make_draft(model, params, num_layers=None, spec_heads=2)
+    assert d.cfg.num_layers == 2 and d.cfg.spec_heads == 2
+
+
+def test_engine_adaptive_k_varies_without_retrace():
+    """Per-slot adaptive proposal depth: with a lossy (truncated+heads)
+    draft the acceptance EMA moves k_eff off its ceiling, streams stay
+    bitwise vs generate(), and the steady state performs ZERO fresh
+    traces and zero pjit cache growth while k varies."""
+    from pytorchdistributed_tpu.inference import make_draft
+    from pytorchdistributed_tpu.serving.engine import (
+        spec_decode_tick_heads,
+    )
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=3)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+               for m in (5, 9, 3, 13, 7)]
+    news = [8, 5, 9, 6, 7]
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16,
+                           block_size=8, spec_k=4, draft_config=draft.cfg,
+                           draft_params=dp, adaptive_k=True)
+    engine.warmup(prompt_lens=(8, 16))
+    traces = dict(serving_engine.TRACE_COUNTS)
+    size0 = spec_decode_tick_heads._cache_size()
+    reqs, seen_k = [], set()
+    for p, n in zip(prompts, news):
+        reqs.append(engine.submit(p, max_new_tokens=n))
+        engine.step()
+        seen_k.update(np.asarray(engine._k_eff).tolist())
+    engine.run_until_idle()
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    assert spec_decode_tick_heads._cache_size() == size0
+    assert len(seen_k) > 1, \
+        "adaptive k never moved — the truncated draft accepted everything"
+    for p, n, r in zip(prompts, news, reqs):
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=n)
+        np.testing.assert_array_equal(r.output_ids, np.asarray(ref)[0])
+    s = engine.summary()
+    assert s["adaptive_k"] is True
+    assert 0.0 < s["accept_ema"] <= 1.0
+    assert 1.0 <= s["effective_k"] <= 4.0
+    engine.close()
+
+
+def test_engine_draft_hot_swap_midstream_bitwise():
+    """set_draft_params mid-stream: resident streams keep ticking and
+    stay bitwise vs generate() across the swap (draft values move
+    acceptance only — the rejection kernel is lossless either way), the
+    swap counter and params fingerprint update, and — the committedness
+    regression — swapping COMMITTED device_put leaves over the boot
+    tree's uncommitted ones must not grow the pjit cache."""
+    from pytorchdistributed_tpu.inference import make_draft
+    from pytorchdistributed_tpu.serving.engine import (
+        spec_decode_tick_heads,
+    )
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=2)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, cfg.vocab_size, (m,)).astype(np.int32)
+               for m in (6, 11, 4)]
+    engine = ServingEngine(model, params, num_slots=3, prefill_bucket=16,
+                           block_size=8, spec_k=3, draft_config=draft.cfg,
+                           draft_params=dp)
+    engine.warmup(prompt_lens=(8, 16))
+    reqs = [engine.submit(p, max_new_tokens=16) for p in prompts]
+    engine.step()
+    hash0 = engine.draft_params_hash()
+    size0 = spec_decode_tick_heads._cache_size()
+    traces = dict(serving_engine.TRACE_COUNTS)
+    # a genuinely different draft, shipped as COMMITTED arrays (the
+    # checkpoint-restore shape): same treedef/shapes/dtypes, new values
+    perturbed = jax.tree.map(
+        lambda x: jax.device_put(np.asarray(x) * np.float32(0.5),
+                                 jax.devices()[0]),
+        dp["params"])
+    engine.set_draft_params({"params": perturbed})
+    assert engine.draft_swaps == 1
+    assert engine.draft_params_hash() != hash0
+    engine.run_until_idle()
+    assert spec_decode_tick_heads._cache_size() == size0
+    assert dict(serving_engine.TRACE_COUNTS) == traces
+    for p, r in zip(prompts, reqs):
+        ref = generate(dm, params, jnp.asarray(p)[None], max_new_tokens=16)
+        np.testing.assert_array_equal(r.output_ids, np.asarray(ref)[0],
+                                      err_msg=f"request {r.id}")
+    assert engine.summary()["draft_swaps"] == 1
+    engine.close()
+
+
+def test_engine_draft_hot_swap_refusals():
+    """A hot-swap may only replace VALUES: architecture (treedef),
+    shape, and dtype changes are refused loudly, and spec-off engines
+    have no draft to swap."""
+    from pytorchdistributed_tpu.inference import make_draft
+
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=64)
+    model = GPT2(cfg)
+    params = _init(model)
+    dm = GPT2(dataclasses.replace(cfg, decode=True))
+    draft, dp = make_draft(dm, params, num_layers=1, spec_heads=2)
+    engine = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                           block_size=8, spec_k=3, draft_config=draft.cfg,
+                           draft_params=dp)
+    other, odp = make_draft(dm, params, num_layers=1, spec_heads=1)
+    with pytest.raises(ValueError, match="structure"):
+        engine.set_draft_params(odp)
+    with pytest.raises(ValueError, match="dtype"):
+        engine.set_draft_params(jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.bfloat16), dp["params"]))
+    engine.close()
+    plain = ServingEngine(model, params, num_slots=2, prefill_bucket=16,
+                          block_size=8)
+    with pytest.raises(ValueError, match="spec_k"):
+        plain.set_draft_params(dp)
+    plain.close()
