@@ -872,12 +872,17 @@ def bench_serve() -> dict:
         result["prefix_ab"] = _serve_prefix_ab(block)
         if os.environ.get("PTD_SPEC_AB", "1") != "0":
             result["spec_ab"] = _serve_spec_ab(block, spec_k)
+    # request-tracing cost twin (ISSUE 17) — default OFF: it stands up
+    # its own small fleet, so only pay for it when asked
+    if os.environ.get("PTD_TRACE_AB", "0") == "1":
+        result["trace_ab"] = _trace_overhead_ab()
     _stamp_overrides(result, ("PTD_SERVE_SIZE", "PTD_SERVE_SLOTS",
                               "PTD_SERVE_REQUESTS", "PTD_SERVE_RATE",
                               "PTD_SERVE_MAX_NEW", "PTD_SERVE_PAGED",
                               "PTD_SERVE_BLOCK", "PTD_SERVE_AB",
                               "PTD_SERVE_SPEC", "PTD_SPEC_K",
-                              "PTD_SPEC_AB", "PTD_QUANT"))
+                              "PTD_SPEC_AB", "PTD_TRACE_AB",
+                              "PTD_QUANT"))
     return result
 
 
@@ -1553,6 +1558,115 @@ def bench_autoscale() -> dict:
     return result
 
 
+def _trace_overhead_ab() -> dict:
+    """Request-tracing on/off A/B (ISSUE 17 satellite): the SAME seeded
+    traffic.py trace replayed through two identical warmed in-process
+    disagg fleets — telemetry dir present in BOTH legs so the only
+    delta is the tracer — stamping ``trace_overhead_frac`` (min-wall
+    on / min-wall off - 1), which must land < 0.01: a span is one dict
+    + one line-buffered host write, invisible next to the jit work."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import (
+        ROLE_DECODE,
+        ROLE_PREFILL,
+        FakeClock,
+        ReplicaRouter,
+        TenantTraffic,
+        make_trace,
+        replay,
+    )
+    from pytorchdistributed_tpu.telemetry.tracing import critical_paths, \
+        read_trace
+
+    reps = int(os.environ.get("PTD_TRACE_AB_REPS", "8"))
+    n_target = int(os.environ.get("PTD_TRACE_AB_REQUESTS", "36"))
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=128,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    traffic = make_trace(
+        seed=17, duration_s=n_target / 18.0, base_qps=18.0,
+        shape="steady",
+        tenants=(TenantTraffic("hot", share=3.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=cfg.vocab_size, prompt_cap=24, new_cap=8)
+
+    def build(trace_on: bool):
+        d = tempfile.mkdtemp(prefix="ptd_trace_ab_")
+        router = ReplicaRouter(
+            model, params, replicas=2,
+            roles=(ROLE_PREFILL, ROLE_DECODE),
+            engine_kwargs=dict(num_slots=4, prefill_bucket=32,
+                               block_size=16),
+            warmup_lens=(32,), faults=None,
+            telemetry_dir=d, trace=trace_on)
+        router.warmup()
+        # one untimed replay pays jit compiles + warms every host path
+        replay(router, traffic, clock=FakeClock(), tick_s=0.02)
+        return router, d
+
+    def timed(router) -> float:
+        # one SAMPLE = three back-to-back replays: a single replay is
+        # short enough (~0.3 s) that scheduler jitter alone is ±1-2%,
+        # the same order as the bar being measured
+        t0 = time.perf_counter()
+        for _ in range(3):
+            replay(router, traffic, clock=FakeClock(), tick_s=0.02)
+        return time.perf_counter() - t0
+
+    # PERSISTENT fleets (one per leg, warmed once) so router
+    # construction/warmup jitter never enters the timing; then
+    # INTERLEAVED timed replays (off, on, off, on, ...) so clock drift
+    # / machine noise hits both legs evenly; min-of-reps is the
+    # comparison — it converges on each leg's floor, where the only
+    # remaining delta is the tracer itself
+    r_off, d_off = build(False)
+    r_on, d_on = build(True)
+    off_s = on_s = None
+    # GC pinned out of the timed region (identically for both legs):
+    # in a process that has already run a full bench leg, a gen-2
+    # collection landing inside one replay costs more than the tracer
+    # does in total, which would swamp a < 1% comparison with
+    # collector-scheduling noise
+    import gc
+    gc.collect()
+    gc.disable()
+    try:
+        for i in range(reps):
+            # alternate which leg goes first so slow drift (thermal,
+            # background load ramps) cancels instead of biasing one leg
+            legs = ((r_off, False), (r_on, True))
+            for router, is_on in (legs if i % 2 == 0 else legs[::-1]):
+                w = timed(router)
+                if is_on:
+                    on_s = w if on_s is None else min(on_s, w)
+                else:
+                    off_s = w if off_s is None else min(off_s, w)
+    finally:
+        gc.enable()
+    r_off.close()
+    r_on.close()
+    paths = critical_paths(read_trace(d_on))
+    out = {
+        "requests": len(traffic), "reps": reps,
+        "off_wall_s": round(off_s, 4), "on_wall_s": round(on_s, 4),
+        "trace_overhead_frac": round(on_s / off_s - 1.0, 4),
+        "traced_requests": len(paths),
+        "connected": sum(p["connected"] for p in paths),
+    }
+    shutil.rmtree(d_off, ignore_errors=True)
+    shutil.rmtree(d_on, ignore_errors=True)
+    return out
+
+
 def bench_disagg() -> dict:
     """Disaggregated serving A/B (ISSUE 12): the SAME bursty
     shared-prefix trace (one hot system prompt + unique tails, arriving
@@ -1575,8 +1689,12 @@ def bench_disagg() -> dict:
     stream programs). The headline is the disagg-vs-colocated TTFT p99
     ratio. PTD_DISAGG_AB=0 skips the colocated twin (stamps the disagg
     leg alone). Knobs: PTD_DISAGG_{PREFILL,DECODE,SLOTS,REQUESTS,
-    MAX_NEW,BLOCK,PREFIX_LEN}; PTD_QUANT rides the model config."""
+    MAX_NEW,BLOCK,PREFIX_LEN}; PTD_QUANT rides the model config.
+    PTD_TRACE=1 runs both legs with request tracing on; PTD_TRACE_AB=1
+    adds the tracing-cost twin (``trace_ab.trace_overhead_frac``)."""
     import os
+    import shutil
+    import tempfile
 
     import jax
     import jax.numpy as jnp
@@ -1622,9 +1740,17 @@ def bench_disagg() -> dict:
               prefill_chunk=64)
 
     def leg(roles) -> dict:
+        # PTD_TRACE=1 runs the leg with request tracing on (its own
+        # scratch telemetry dir) and stamps the traced/connected counts
+        # next to the serving numbers
+        tracing_on = os.environ.get("PTD_TRACE", "0").lower() in (
+            "1", "true", "yes", "on")
+        tdir = tempfile.mkdtemp(prefix="ptd_disagg_trace_") \
+            if tracing_on else None
         router = ReplicaRouter(model, params, replicas=len(roles),
                                roles=roles, engine_kwargs=ek,
-                               warmup_lens=(64,), faults=None)
+                               warmup_lens=(64,), faults=None,
+                               telemetry_dir=tdir)
         router.warmup()
         traces0 = dict(serving_engine.TRACE_COUNTS)
         reqs = _drive_router_trace(router, list(prompts),
@@ -1634,6 +1760,18 @@ def bench_disagg() -> dict:
         s = router.summary()
         engines = [r.engine.summary() for r in router._replicas]
         router.close()
+        trace_stats = None
+        if tracing_on:
+            from pytorchdistributed_tpu.telemetry.tracing import (
+                critical_paths,
+                read_trace,
+            )
+
+            paths = critical_paths(read_trace(tdir))
+            trace_stats = {"traced_requests": len(paths),
+                           "connected": sum(p["connected"]
+                                            for p in paths)}
+            shutil.rmtree(tdir, ignore_errors=True)
         decoded = [e["decode_tokens_per_s"] for e in engines
                    if e.get("decode_tokens_per_s")]
         unfinished = sum(1 for q in reqs
@@ -1657,6 +1795,7 @@ def bench_disagg() -> dict:
             "kv_stream_bytes": s.get("kv_stream_bytes", 0),
             "unfinished": unfinished,        # must stamp 0
             "recompiles": recompiles,        # must stamp 0
+            **({"trace": trace_stats} if trace_stats else {}),
         }
 
     disagg = leg([ROLE_PREFILL] * n_prefill + [ROLE_DECODE] * n_decode)
@@ -1678,11 +1817,13 @@ def bench_disagg() -> dict:
             result["decode_tokens_ratio"] = round(
                 disagg["decode_tokens_per_s"]
                 / colo["decode_tokens_per_s"], 3)
+    if os.environ.get("PTD_TRACE_AB", "0") == "1":
+        result["trace_ab"] = _trace_overhead_ab()
     _stamp_overrides(result, ("PTD_DISAGG_PREFILL", "PTD_DISAGG_DECODE",
                               "PTD_DISAGG_SLOTS", "PTD_DISAGG_REQUESTS",
                               "PTD_DISAGG_MAX_NEW", "PTD_DISAGG_BLOCK",
                               "PTD_DISAGG_PREFIX_LEN", "PTD_DISAGG_AB",
-                              "PTD_QUANT"))
+                              "PTD_TRACE", "PTD_TRACE_AB", "PTD_QUANT"))
     return result
 
 

@@ -304,6 +304,23 @@ _QUICK = (
     "test_autoscale.py::test_per_request_window_override_bitwise",
     "test_autoscale.py::test_kv_override_rejection_walls",
     "test_autoscale.py::test_engine_preempt_request_lossless_and_states",
+    # distributed request tracing (ISSUE 17): context/wire units, the
+    # critical-path exact-tiling sweep + TTFT clip, SLO-debt
+    # attribution, chrome-lane tid coercion, the KV-payload origin/
+    # trace carry, CLI + report tables, the in-process disagg fleet
+    # e2e (handoff + injected failover, 100% connected chains, stage
+    # sums tile the terminal latency) and the off-means-off pin (zero
+    # recompiles, identical event streams). The SUBPROCESS wire e2e
+    # (spawns jax-importing workers) stays full-suite-only.
+    "test_tracing.py::test_trace_context_wire_roundtrip",
+    "test_tracing.py::test_tracer_rows_and_clock_anchor",
+    "test_tracing.py::test_critical_path_exact_tiling_and_ttft_clip",
+    "test_tracing.py::test_slo_debt_attribution_and_tracer_ledger",
+    "test_tracing.py::test_chrome_trace_lanes_and_tid_coercion",
+    "test_tracing.py::test_kv_payload_wire_carries_origin_and_trace",
+    "test_tracing.py::test_trace_cli_and_report_section",
+    "test_tracing.py::test_fleet_trace_connected_across_handoff_and_failover",
+    "test_tracing.py::test_tracing_off_is_off",
 )
 
 

@@ -98,12 +98,23 @@ def main():
                              "scheduler keeps the token split weighted-"
                              "fair, and the summary prints the per-"
                              "tenant table")
+    parser.add_argument("--trace", action="store_true",
+                        help="fleet-wide request tracing (README "
+                             "'Distributed request tracing'): every "
+                             "request carries a TraceContext through "
+                             "queue/admission/prefill/handoff/decode, "
+                             "and the run ends with the per-stage "
+                             "critical-path + SLO-debt report (needs "
+                             "--telemetry-dir; serves via the router)")
     parser.add_argument("--chaos", action="store_true",
                         help="with --replicas > 1: crash replica 0 "
                              "mid-trace — watch the router redispatch "
                              "its streams to a survivor with the SAME "
                              "tokens")
     args = parser.parse_args()
+    if args.trace and not args.telemetry_dir:
+        parser.error("--trace needs --telemetry-dir (spans are "
+                     "trace_rank*.jsonl files in the run dir)")
     if args.spec_k and not args.block_size:
         args.block_size = 16  # spec requires the paged engine
     roles = None
@@ -169,7 +180,7 @@ def main():
                                               args.draft_layers)
         spec_kw = dict(draft_config=draft.cfg, draft_params=draft_params)
 
-    if args.replicas > 1 or args.autoscale or args.tenants:
+    if args.replicas > 1 or args.autoscale or args.tenants or args.trace:
         # REPLICATED serving (ISSUE 9): the router owns N engines,
         # balances on their health snapshots and — with --chaos — shows
         # lossless mid-stream failover: the crashed replica's streams
@@ -181,6 +192,11 @@ def main():
         # no --chaos: leave the router's default ("auto") so the
         # PTD_FAULTS env contract keeps working through the demo
         router_kw = {}
+        if args.trace:
+            # request tracing (ISSUE 17): every submit mints a
+            # TraceContext; the run ends with the merged critical-path
+            # report over trace_rank*.jsonl
+            router_kw["trace"] = True
         if args.chaos:
             # the supported chaos contract — the same spec syntax
             # `run.py --faults` / PTD_FAULTS accept; the router fires
@@ -291,6 +307,13 @@ def main():
                       f"{r.prompt.tolist()} -> {r.tokens}")
         print("router summary:", router.summary())
         router.close()
+        if args.trace:
+            from pytorchdistributed_tpu.telemetry.tracing import (
+                render_trace,
+            )
+
+            print()
+            print(render_trace(args.telemetry_dir, top=args.requests))
         ptd.destroy_process_group()
         return
 

@@ -298,6 +298,10 @@ class AdmissionController:
                 break
         q = self._queues[pick]
         rr = q.popleft()
+        # stamp for the tracer's queue/admission split (WDRR residency
+        # ends HERE; dispatch/placement latency starts) — a host attr
+        # write, free whether tracing is on or not
+        rr.dequeue_time = time.perf_counter()
         self._deficit[pick] -= costs[pick]
         self._served[pick] += costs[pick]
         if not q:

@@ -133,7 +133,7 @@ class Autoscaler:
         what a decision event gets stamped with."""
         sub = self._sig(snap, "submitted", "sum") or 0.0
         shed = self._sig(snap, "shed", "sum") or 0.0
-        return {
+        m = {
             "queue_depth": self._sig(snap, "queue_depth") or 0.0,
             "ttft_ema_s": self._sig(snap, "ttft_ema_s", "last"),
             "shed_rate": (shed / sub) if sub else 0.0,
@@ -143,6 +143,15 @@ class Autoscaler:
             "draining": st.get("draining", 0),
             "quarantined": st.get("quarantined", 0),
         }
+        # when the router carries a request tracer (ISSUE 17), its live
+        # per-tenant SLO-debt ledger rides the same decision snapshot —
+        # "slo_debt_s" (total TTFT seconds beyond budget) and
+        # "slo_debt_tenant" (the worst offender) land in every stamped
+        # decision event
+        tracer = getattr(self.router, "trace", None)
+        if tracer is not None:
+            m.update(tracer.debt_totals())
+        return m
 
     def _breaches(self, pool: str, m: dict) -> list[str]:
         """Which SLO signals this pool is currently violating. Role-

@@ -92,6 +92,11 @@ from pytorchdistributed_tpu.serving.paging import (
     RadixPrefixCache,
 )
 from pytorchdistributed_tpu.serving.telemetry import ServingTelemetry
+from pytorchdistributed_tpu.telemetry.tracing import (
+    TraceContext,
+    from_unix as _trace_from_unix,
+    to_unix as _trace_to_unix,
+)
 
 # Traced-body invocation counter (same discipline as inference.
 # TRACE_COUNTS): the zero-recompiles-after-warmup guarantee is asserted
@@ -568,6 +573,15 @@ class KVBlockPayload:
     # fails with a sentence, not garbage tokens
     kv_dtype: str = "bf16"
     wire_version: int = KV_WIRE_VERSION
+    # the ORIGIN router submit as unix-epoch seconds (ISSUE 17
+    # satellite): the importer maps it onto its own clock so a
+    # handed-off stream's end-to-end TTFT measures from the FIRST
+    # router submit, not decode-replica-local; None from pre-ISSUE-17
+    # exporters
+    origin_t: float | None = None
+    # the request's TraceContext wire dict — the handoff keeps the
+    # stream on ONE connected trace across replicas
+    trace: dict | None = None
 
     @property
     def num_blocks(self) -> int:
@@ -640,7 +654,8 @@ def kv_payload_to_wire(p: KVBlockPayload) -> dict:
                 sampling=dataclasses.asdict(p.sampling),
                 stop_ids=list(p.stop_ids),
                 leaves=_leaves_to_wire(p.leaves),
-                kv_dtype=p.kv_dtype, wire_version=p.wire_version)
+                kv_dtype=p.kv_dtype, wire_version=p.wire_version,
+                origin_t=p.origin_t, trace=p.trace)
 
 
 def kv_payload_from_wire(d: dict) -> KVBlockPayload:
@@ -655,7 +670,8 @@ def kv_payload_from_wire(d: dict) -> KVBlockPayload:
         # pre-v2 senders carried neither field: report them as v1 so the
         # importer's version check names the mismatch instead of KeyError
         kv_dtype=str(d.get("kv_dtype", "bf16")),
-        wire_version=int(d.get("wire_version", 1)))
+        wire_version=int(d.get("wire_version", 1)),
+        origin_t=d.get("origin_t"), trace=d.get("trace"))
 
 
 def prefix_payload_to_wire(p: PrefixBlockPayload) -> dict:
@@ -733,6 +749,25 @@ class Request:
         # engine-static defaults
         self.kv_window: int | None = None
         self.kv_sink: int | None = None
+        # distributed tracing (ISSUE 17): the router-minted
+        # TraceContext this request's engine-side spans attach to, and
+        # the ORIGIN router submit mapped onto THIS process's
+        # perf_counter clock (equal to submit_time for a locally-born
+        # request; earlier for one that arrived via handoff/redispatch)
+        self.trace = None
+        self.origin_submit_time: float | None = None
+
+    @property
+    def ttft_e2e_s(self) -> float | None:
+        """Time to first token measured from the ORIGIN router submit
+        (ISSUE 17 satellite) — on a handed-off stream this spans queue
+        + prefill + handoff end-to-end, where ``ttft_s`` restarts at
+        the import. Falls back to ``ttft_s`` when no origin rode in."""
+        if self.first_token_time is None:
+            return None
+        if self.origin_submit_time is None:
+            return self.ttft_s
+        return self.first_token_time - self.origin_submit_time
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -893,7 +928,7 @@ class ServingEngine:
                  compile_cache="auto", kv_dtype: str | None = None,
                  kv_sink_tokens: int | None = None,
                  kv_window_tokens: int | None = None,
-                 paged_attn: str | None = None):
+                 paged_attn: str | None = None, trace=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -1123,6 +1158,14 @@ class ServingEngine:
         if telemetry is None and telemetry_dir is not None:
             telemetry = ServingTelemetry(telemetry_dir)
         self.telemetry = telemetry
+        # request tracing (ISSUE 17): a telemetry.tracing.RequestTracer
+        # — the router shares its own with in-process engines, a
+        # subprocess worker builds one from PTD_TRACE + the telemetry
+        # dir. None (the default) means OFF: every emit site guards on
+        # it, so off costs nothing per tick. The engine never closes it
+        # (the owner does); rows are line-buffered, so a crashed worker
+        # loses nothing.
+        self.trace = trace
         # AOT executable table (ISSUE 10): with a compile cache
         # attached, every compiled-program call goes through _aot_call —
         # a per-program jax.stages.Compiled either deserialized from the
@@ -1145,7 +1188,8 @@ class ServingEngine:
                on_token=None, deadline_s: float | None = None,
                generated=None, prefill_only: bool = False,
                kv_window: int | None = None,
-               kv_sink: int | None = None) -> Request:
+               kv_sink: int | None = None,
+               trace=None, origin_t: float | None = None) -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
         steps). ``stop_ids`` accepts a single id or a sequence.
@@ -1256,6 +1300,18 @@ class ServingEngine:
             sink = min(self.kv_sink_tokens, self._round_up(sink, bs))
             req.kv_window, req.kv_sink = int(win), int(sink)
         req.submit_time = time.perf_counter()
+        # distributed tracing + origin timestamp (ISSUE 17): ``trace``
+        # is the router-minted TraceContext (a wire dict from the
+        # subprocess protocol is accepted as-is); ``origin_t`` the
+        # FIRST router submit as unix-epoch seconds, mapped onto this
+        # process's clock so TTFT-e2e survives redispatch across
+        # processes
+        if trace is not None:
+            req.trace = (trace if isinstance(trace, TraceContext)
+                         else TraceContext.from_wire(trace))
+        req.origin_submit_time = (
+            req.submit_time if origin_t is None
+            else _trace_from_unix(float(origin_t)))
         self._queue.append(req)
         return req
 
@@ -1652,6 +1708,10 @@ class ServingEngine:
             req.first_token_time = now
             if req.submit_time is not None:
                 self._note_ttft(now - req.submit_time)
+        self._trace_span(req, "prefill", req.submit_time, now,
+                         chunks=req.prefill_chunks,
+                         parked=bool(req.prefill_only and not req.done),
+                         resumed_from=req.resumed_from)
         self._active[slot] = req
         self._admit_order[slot] = next(self._admit_seq)
         if self.per_slot_limits:
@@ -1938,7 +1998,12 @@ class ServingEngine:
             max_new_tokens=req.max_new_tokens, sampling=req.sampling,
             stop_ids=tuple(req.stop_ids),
             leaves=self._gather_blocks(self._slot_blocks[slot][:nb]),
-            kv_dtype=self.kv_dtype)
+            kv_dtype=self.kv_dtype,
+            # the ORIGIN submit + trace identity ride the handoff
+            # (ISSUE 17): unix-epoch so two processes agree on it
+            origin_t=(None if req.origin_submit_time is None
+                      else _trace_to_unix(req.origin_submit_time)),
+            trace=(None if req.trace is None else req.trace.to_wire()))
         self._release_slot(slot)
         req.slot = None
         req.parked = False
@@ -2020,6 +2085,14 @@ class ServingEngine:
         # the exporter timed the real TTFT; this engine's EMA must not
         # absorb a handoff as a near-zero first token
         req.first_token_time = req.submit_time
+        # end-to-end identity (ISSUE 17): the ORIGIN router submit and
+        # the TraceContext arrive in the payload — ttft_e2e_s and the
+        # decode-side spans stay on the request's one fleet-wide trace
+        req.origin_submit_time = (
+            req.submit_time if payload.origin_t is None
+            else _trace_from_unix(float(payload.origin_t)))
+        if payload.trace is not None:
+            req.trace = TraceContext.from_wire(payload.trace)
         slot = self._free.pop()
         req.slot = slot
         self._slot_blocks[slot] = list(blocks)
@@ -2463,6 +2536,8 @@ class ServingEngine:
             req.first_token_time = now
             if req.submit_time is not None:
                 self._note_ttft(now - req.submit_time)
+        self._trace_span(req, "prefill", req.submit_time, now,
+                         resumed_from=req.resumed_from)
         self._active[slot] = req
         self._key_data[slot] = kd
         self._counts[slot] = resume + 1  # token n samples fold_in(key, n)
@@ -2498,8 +2573,25 @@ class ServingEngine:
         self._stats["completed"] += 1
         if reason == "deadline":
             self._stats["deadline_expired"] += 1
+        self._trace_span(
+            req, "decode",
+            (req.first_token_time if req.first_token_time is not None
+             else req.submit_time),
+            req.finish_time, new_tokens=len(req.new_tokens),
+            finish_reason=reason, preemptions=req.preemptions)
         if self.telemetry is not None:
             self.telemetry.request(req)
+
+    def _trace_span(self, req: Request, stage: str, t0, t1,
+                    **attrs) -> None:
+        """Emit one request-trace span (ISSUE 17) — a no-op unless BOTH
+        a tracer is wired and the request carries a TraceContext, so
+        tracing off costs one attribute read per lifecycle edge."""
+        if self.trace is None or req.trace is None or t0 is None:
+            return
+        if self.telemetry is not None:
+            attrs.setdefault("replica", self.telemetry.rank)
+        self.trace.span(req.trace, stage, t0, t1, **attrs)
 
     def _note_ttft(self, dt: float) -> None:
         self._stats["ttft_s"].append(dt)

@@ -176,6 +176,11 @@ def _build_engine(spec: dict):
         model = GPT2(cfg)
     params = _load_params(spec, model)
     telemetry = ServingTelemetry.from_env()
+    # each worker writes its own trace_rank{RANK}.jsonl — None (and
+    # zero per-request work) unless the launcher exported PTD_TRACE
+    from pytorchdistributed_tpu.telemetry.tracing import RequestTracer
+
+    trace = RequestTracer.from_env()
     engine_kwargs = dict(spec.get("engine", {}))
     if spec.get("compile_cache"):
         engine_kwargs.setdefault("compile_cache", spec["compile_cache"])
@@ -192,7 +197,7 @@ def _build_engine(spec: dict):
         engine_kwargs.setdefault("draft_params", draft_params)
         draft_ckpt = draft.get("checkpoint")
     engine = ServingEngine(model, params, telemetry=telemetry,
-                           **engine_kwargs)
+                           trace=trace, **engine_kwargs)
     if draft_ckpt:
         # distilled weights ride the SAME verified path as a later
         # hot-swap — a bad draft checkpoint degrades to the warm-start
@@ -252,6 +257,8 @@ def main() -> int:
             closed[0] = True
             engine.drain()
             engine.close()
+            if engine.trace is not None:
+                engine.trace.close()
 
     try:
         return _serve(engine, heartbeat, injector, rank, delivered,
@@ -303,7 +310,9 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                     on_token=on_token,
                     prefill_only=bool(op.get("prefill_only")),
                     kv_window=op.get("kv_window"),
-                    kv_sink=op.get("kv_sink"))
+                    kv_sink=op.get("kv_sink"),
+                    trace=op.get("trace"),
+                    origin_t=op.get("origin_t"))
             except ValueError as e:
                 # a malformed request must cost ONE refusal, not the
                 # worker process (and then, replica by replica, the

@@ -88,6 +88,11 @@ from pytorchdistributed_tpu.serving.paging import (
     block_hashes,
 )
 from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
+from pytorchdistributed_tpu.telemetry.events import TELEMETRY_DIR_ENV
+from pytorchdistributed_tpu.telemetry.tracing import (
+    RequestTracer,
+    to_unix as _trace_to_unix,
+)
 
 #: Replica lifecycle states. HEALTHY serves traffic; QUARANTINED is
 #: alive but sick (params non-finite) — probed every tick, rejoined
@@ -178,6 +183,13 @@ class RouterRequest:
         self._handle = None                  # engine-side request/mirror
         self._replica: int | None = None
         self._hash_chain: list[str] | None = None  # fleet prefix index
+        # distributed tracing (ISSUE 17): the TraceContext minted at
+        # router submit (None when tracing is off), the current
+        # queue-residency start (reset at every requeue), and the last
+        # WDRR dequeue stamp (admission.popleft writes it)
+        self.trace = None
+        self._trace_enq_t: float | None = None
+        self.dequeue_time: float | None = None
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -237,7 +249,10 @@ class InProcessReplica:
             sampling=rr.sampling, stop_ids=rr.stop_ids,
             deadline_s=deadline_s, generated=generated, on_token=on_token,
             prefill_only=prefill_only,
-            kv_window=rr.kv_window, kv_sink=rr.kv_sink)
+            kv_window=rr.kv_window, kv_sink=rr.kv_sink,
+            trace=rr.trace,
+            origin_t=(None if rr.submit_time is None
+                      else _trace_to_unix(rr.submit_time)))
 
     def preempt(self, rr: RouterRequest) -> bool:
         """Evict the stream losslessly (admission-pressure preemption):
@@ -506,20 +521,31 @@ class SubprocessReplica:
     def submit(self, rr: RouterRequest, *, generated, deadline_s,
                on_token, prefill_only: bool = False):
         self._drain_wire()
-        self._send({"op": "submit", "rid": rr.id,
-                    "prompt": rr.prompt.tolist(),
-                    "max_new_tokens": rr.max_new_tokens,
-                    "sampling": {
-                        "temperature": rr.sampling.temperature,
-                        "top_k": rr.sampling.top_k,
-                        "top_p": rr.sampling.top_p,
-                        "seed": rr.sampling.seed},
-                    "stop_ids": list(rr.stop_ids),
-                    "generated": list(generated or []),
-                    "deadline_s": deadline_s,
-                    "prefill_only": bool(prefill_only),
-                    "kv_window": rr.kv_window,
-                    "kv_sink": rr.kv_sink})
+        op = {"op": "submit", "rid": rr.id,
+              "prompt": rr.prompt.tolist(),
+              "max_new_tokens": rr.max_new_tokens,
+              "sampling": {
+                  "temperature": rr.sampling.temperature,
+                  "top_k": rr.sampling.top_k,
+                  "top_p": rr.sampling.top_p,
+                  "seed": rr.sampling.seed},
+              "stop_ids": list(rr.stop_ids),
+              "generated": list(generated or []),
+              "deadline_s": deadline_s,
+              "prefill_only": bool(prefill_only),
+              "kv_window": rr.kv_window,
+              "kv_sink": rr.kv_sink}
+        # origin submit + trace identity (ISSUE 17): unix-epoch and a
+        # plain dict so the worker needs no shared clock or objects;
+        # trace keys ride only when tracing minted a context, so the
+        # off-wire is byte-identical to pre-ISSUE-17 traffic minus the
+        # always-on origin stamp (the TTFT-e2e bugfix is not gated on
+        # tracing)
+        if rr.submit_time is not None:
+            op["origin_t"] = _trace_to_unix(rr.submit_time)
+        if rr.trace is not None:
+            op["trace"] = rr.trace.to_wire()
+        self._send(op)
         self._on_token[rr.id] = on_token
         m = _Mirror()
         self._mirrors[rr.id] = m
@@ -837,11 +863,40 @@ class ReplicaRouter:
                  faults="auto", telemetry: RouterTelemetry | None = None,
                  telemetry_dir=None, sample_every: int = 1,
                  tenants=None, admission=None,
-                 preempt_every: int = 8, seed: int = 0):
+                 preempt_every: int = 8, seed: int = 0,
+                 trace="auto", slo_ttft_s: float | None = None):
         self.warmup_lens = tuple(warmup_lens) if warmup_lens else None
+        # distributed request tracing (ISSUE 17): OFF unless asked —
+        # trace=True (needs telemetry_dir for the files), a
+        # RequestTracer instance, or the default "auto" which honors
+        # the PTD_TRACE env contract (so subprocess fleets flip one
+        # env var and every worker's tracer comes up with the router's).
+        # In-process engines SHARE this tracer (one process, one file);
+        # subprocess workers build their own per-RANK one from the env.
+        if isinstance(trace, RequestTracer):
+            self.trace = trace
+        elif trace is True:
+            if telemetry_dir is None:
+                raise ValueError(
+                    "trace=True needs telemetry_dir= — the per-rank "
+                    "trace_rank*.jsonl files land there")
+            self.trace = RequestTracer(
+                telemetry_dir, rank="router",
+                **({} if slo_ttft_s is None
+                   else {"slo_ttft_s": slo_ttft_s}))
+        elif trace == "auto" and telemetry_dir is not None \
+                and os.environ.get("PTD_TRACE", "0").lower() in (
+                    "1", "true", "yes", "on"):
+            self.trace = RequestTracer(
+                telemetry_dir, rank="router",
+                **({} if slo_ttft_s is None
+                   else {"slo_ttft_s": slo_ttft_s}))
+        else:
+            self.trace = None
         self._hb_dir = None
         self._worker_specs = None
         self._worker_port = None
+        self._worker_env = None
         self._factory_fn = None
         if workers is not None:
             import tempfile
@@ -860,10 +915,18 @@ class ReplicaRouter:
             # clone spec 0
             self._base_specs = list(workers)
             self._worker_port = port
+            # a programmatic trace=True must reach the workers too —
+            # export the same env contract the "auto" path reads, so
+            # every worker's RequestTracer.from_env comes up
+            if self.trace is not None:
+                self._worker_env = {
+                    "PTD_TRACE": "1",
+                    TELEMETRY_DIR_ENV: self.trace.run_dir}
             self._replicas = [
                 SubprocessReplica(i, spec, world_size=len(workers),
                                   heartbeat_dir=self._hb_dir,
-                                  master_port=port)
+                                  master_port=port,
+                                  env=self._worker_env)
                 for i, spec in enumerate(workers)]
             self.max_seq_len = min(
                 int(s.get("max_seq_len",
@@ -884,6 +947,9 @@ class ReplicaRouter:
                 wire_tele = (telemetry_dir is not None
                              and "telemetry" not in kw
                              and "telemetry_dir" not in kw)
+                # in-process engines emit request spans through the
+                # ROUTER's tracer (same process, same clock, one file)
+                wire_trace = self.trace is not None and "trace" not in kw
 
                 def make_factory(i):
                     def factory():
@@ -894,6 +960,8 @@ class ReplicaRouter:
 
                             ekw["telemetry"] = ServingTelemetry(
                                 telemetry_dir, rank=i)
+                        if wire_trace:
+                            ekw["trace"] = self.trace
                         return ServingEngine(model, params, **ekw)
                     return factory
 
@@ -1051,6 +1119,12 @@ class ReplicaRouter:
                            priority=priority, kv_window=kv_window,
                            kv_sink=kv_sink)
         rr.submit_time = time.perf_counter()
+        if self.trace is not None:
+            # mint the request's fleet-wide trace identity here — the
+            # single origin every later emitter (admission, engines on
+            # any replica, the handoff wire) parents to
+            rr.trace = self.trace.new_trace()
+            rr._trace_enq_t = rr.submit_time
         self._stats["submitted"] += 1
         self._tenant_stats(rr.tenant)["submitted"] += 1
         if self._draining:
@@ -1400,7 +1474,8 @@ class ReplicaRouter:
                 r.index, self._worker_specs[r.index],
                 world_size=len(self._replicas),
                 heartbeat_dir=self._hb_dir,
-                master_port=self._worker_port)
+                master_port=self._worker_port,
+                env=self._worker_env)
         if isinstance(r, InProcessReplica):
             return InProcessReplica(r.index, r._factory,
                                     warmup_lens=r.warmup_lens)
@@ -1485,7 +1560,8 @@ class ReplicaRouter:
             self._worker_specs.append(spec)
             fresh = SubprocessReplica(
                 i, spec, world_size=i + 1, heartbeat_dir=self._hb_dir,
-                master_port=self._worker_port)
+                master_port=self._worker_port,
+                env=self._worker_env)
         else:
             fresh = InProcessReplica(i, self._factory_fn(i),
                                      warmup_lens=self.warmup_lens)
@@ -1706,6 +1782,14 @@ class ReplicaRouter:
                         why=why, retries=rr.retries,
                         delay_ms=round(delay * 1e3, 3),
                         tokens_so_far=len(rr.tokens))
+            if self.trace is not None and rr.trace is not None:
+                # marker span: the failover edge itself; queue
+                # residency restarts here, so the NEXT queue span
+                # (and the backoff gap, as stall) attribute correctly
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=r.index, why=why,
+                                retries=rr.retries)
+                rr._trace_enq_t = now
         if pending:
             self._recovering.append(
                 {"start": self._ticks, "start_t": now, "pending": pending})
@@ -1879,6 +1963,11 @@ class ReplicaRouter:
             # and placement: requeue the request, let the health
             # machinery take the replica down
             self._queue.appendleft(rr)
+            if self.trace is not None and rr.trace is not None:
+                now = time.perf_counter()
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=r.index, why="place_crash")
+                rr._trace_enq_t = now
             self._declare_dead(r, "crashed")
             return False
         except ValueError as e:
@@ -1898,6 +1987,23 @@ class ReplicaRouter:
         # keep this tick's snapshot honest for the next pick
         self._health[r.index]["queued"] = \
             self._health[r.index].get("queued", 0) + 1
+        if self.trace is not None and rr.trace is not None:
+            # queue = residency start -> WDRR dequeue; admission =
+            # dequeue -> the engine accepting the stream. The dequeue
+            # stamp comes from AdmissionController.popleft (falls back
+            # to now on the plain-deque path)
+            now = time.perf_counter()
+            t0 = rr._trace_enq_t if rr._trace_enq_t is not None \
+                else rr.submit_time
+            dq = rr.dequeue_time if rr.dequeue_time is not None else now
+            dq = min(max(dq, t0), now)
+            self.trace.span(rr.trace, "queue", t0, dq,
+                            request=rr.id, replica=r.index)
+            self.trace.span(rr.trace, "admission", dq, now,
+                            replica=r.index,
+                            role=self._roles[r.index],
+                            prefill_only=prefill_only)
+            rr._trace_enq_t = None
         return True
 
     def _on_token(self, rr: RouterRequest, replica: int, tok: int) -> None:
@@ -1943,6 +2049,12 @@ class ReplicaRouter:
                     self._event("preempt_requeue", request=rr.id,
                                 tenant=rr.tenant,
                                 tokens_so_far=len(rr.tokens))
+                    if self.trace is not None and rr.trace is not None:
+                        now = time.perf_counter()
+                        self.trace.span(rr.trace, "redispatch", now,
+                                        now, from_replica=r.index,
+                                        why="preempt")
+                        rr._trace_enq_t = now
                     continue
                 self._finish(rr, rr._handle.finish_reason)
 
@@ -1996,6 +2108,7 @@ class ReplicaRouter:
                 tgt, tgt_key = r, key
         if tgt is None:
             return   # parked, not failed: wait for a decode slot
+        t_h0 = time.perf_counter()
         try:
             payload = src.export_kv(rr)
         except (ReplicaCrashed, TimeoutError):
@@ -2015,6 +2128,12 @@ class ReplicaRouter:
             self._stats["handoff_failures"] += 1
             self._event("handoff_failed", request=rr.id,
                         from_replica=src.index, to_replica=None)
+            if self.trace is not None and rr.trace is not None:
+                now = time.perf_counter()
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=src.index,
+                                why="handoff_refused")
+                rr._trace_enq_t = now
             return
         # export released the blocks on src: from here the ONLY copy of
         # the stream's KV is the payload, and the fallback is resume
@@ -2045,6 +2164,12 @@ class ReplicaRouter:
             self._stats["handoff_failures"] += 1
             self._event("handoff_failed", request=rr.id,
                         from_replica=src.index, to_replica=tgt.index)
+            if self.trace is not None and rr.trace is not None:
+                now = time.perf_counter()
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=src.index,
+                                why="handoff_failed")
+                rr._trace_enq_t = now
             return
         rr._handle = handle
         rr._replica = tgt.index
@@ -2065,6 +2190,12 @@ class ReplicaRouter:
         self._event("handoff", request=rr.id, from_replica=src.index,
                     to_replica=tgt.index, blocks=payload.num_blocks,
                     bytes=nbytes)
+        if self.trace is not None and rr.trace is not None:
+            self.trace.span(rr.trace, "handoff", t_h0,
+                            time.perf_counter(),
+                            from_replica=src.index,
+                            to_replica=tgt.index,
+                            blocks=payload.num_blocks, bytes=nbytes)
 
     def _expire_queued_deadlines(self) -> None:
         now = time.perf_counter()
@@ -2103,6 +2234,20 @@ class ReplicaRouter:
         if rr.ttft_s is not None:
             self._stats["ttft_s"].append(rr.ttft_s)
             t["ttft_s"].append(rr.ttft_s)
+        if (self.trace is not None and rr.trace is not None
+                and rr.submit_time is not None):
+            # the ROOT span: every stage span parents to this one, so
+            # connectivity in the merged trace is a single equality
+            # check per span — and its window is what the critical-path
+            # sweep tiles into queue/admission/prefill/handoff/decode/
+            # stall
+            self.trace.span(rr.trace, "request", rr.submit_time,
+                            rr.finish_time, root=True, request=rr.id,
+                            tenant=rr.tenant,
+                            finish_reason=rr.finish_reason,
+                            ttft_s=rr.ttft_s, retries=rr.retries)
+            if reason in ("length", "stop", "deadline"):
+                self.trace.note_finish(rr.tenant, rr.ttft_s)
         for rec in self._recovering:
             rec["pending"].discard(rr.id)
         self._gc_recovering()
@@ -2332,6 +2477,8 @@ class ReplicaRouter:
         if self.telemetry is not None:
             self.telemetry.summary(**self.summary())
             self.telemetry.close()
+        if self.trace is not None:
+            self.trace.close()
 
     # ------------------------------------------------------------------
     # stats

@@ -68,12 +68,21 @@ class ServingTelemetry:
         the dense engine) and the speculative counters (draft proposals
         made / accepted — both 0 when spec is off)."""
         ttft = req.ttft_s
+        # end-to-end TTFT (ISSUE 17 satellite): measured from the
+        # ORIGIN router submit carried across the handoff wire — on a
+        # handed-off stream this is the client-visible number, while
+        # ``ttft_ms`` stays decode-replica-local so existing BENCH
+        # baselines remain comparable
+        e2e = getattr(req, "ttft_e2e_s", None)
+        if e2e is None:
+            e2e = ttft
         self.metrics.write({
             "kind": "request", "time": round(time.time(), 3),
             "id": req.id, "prompt_len": int(req.prompt.size),
             "new_tokens": len(req.new_tokens),
             "finish_reason": req.finish_reason,
             "ttft_ms": None if ttft is None else round(ttft * 1e3, 3),
+            "ttft_e2e_ms": None if e2e is None else round(e2e * 1e3, 3),
             "decode_tokens_per_s": req.decode_tokens_per_s,
             "prefix_hit_tokens": getattr(req, "prefix_hit_tokens", 0),
             "prefill_chunks": getattr(req, "prefill_chunks", 0),

@@ -9,6 +9,11 @@
                      provenance — extra jitted outputs, zero overhead
                      when off (``Trainer(diagnostics=...)`` /
                      PTD_DIAGNOSTICS)
+  * tracing.py     — fleet-wide request tracing (ISSUE 17): one
+                     TraceContext per router submit, propagated across
+                     the wire; per-rank ``trace_rank*.jsonl`` spans
+                     merged into critical-path / SLO-debt tables
+                     (``... telemetry trace <dir>``)
   * report.py      — the cross-rank run report CLI
                      (``python -m pytorchdistributed_tpu.telemetry report``)
 
@@ -41,4 +46,11 @@ from pytorchdistributed_tpu.telemetry.events import (  # noqa: F401
 from pytorchdistributed_tpu.telemetry.spans import (  # noqa: F401
     SpanTracer,
     merge_chrome_traces,
+)
+from pytorchdistributed_tpu.telemetry.tracing import (  # noqa: F401
+    TRACE_ENV,
+    RequestTracer,
+    TraceContext,
+    critical_paths,
+    read_trace,
 )

@@ -5,6 +5,11 @@
                           Chrome-trace JSON (open in ui.perfetto.dev;
                           overlay the jax.profiler device capture by
                           opening both)
+  trace <run-dir>         fleet-wide REQUEST traces (ISSUE 17): top-N
+                          slowest requests with their per-stage
+                          critical-path breakdown + the per-tenant
+                          SLO-debt table; ``--chrome`` additionally
+                          writes a one-lane-per-request Chrome trace
 """
 
 from __future__ import annotations
@@ -15,6 +20,13 @@ import sys
 
 from pytorchdistributed_tpu.telemetry.report import render
 from pytorchdistributed_tpu.telemetry.spans import merge_chrome_traces
+from pytorchdistributed_tpu.telemetry.tracing import (
+    DEFAULT_SLO_TTFT_S,
+    STAGES,
+    chrome_trace,
+    read_trace,
+    render_trace,
+)
 
 
 def main(argv=None) -> int:
@@ -29,9 +41,36 @@ def main(argv=None) -> int:
     mp.add_argument("run_dir")
     mp.add_argument("-o", "--output", default=None,
                     help="output path (default <run-dir>/merged.trace.json)")
+    tp = sub.add_parser("trace",
+                        help="merged request traces: slowest requests "
+                             "by stage + per-tenant SLO debt")
+    tp.add_argument("run_dir")
+    tp.add_argument("--top", type=int, default=10,
+                    help="slowest-request rows to show")
+    tp.add_argument("--tenant", default=None,
+                    help="only this tenant's requests")
+    tp.add_argument("--stage", default=None, choices=list(STAGES),
+                    help="rank by this stage's time instead of total")
+    tp.add_argument("--slo-ttft-ms", type=float,
+                    default=DEFAULT_SLO_TTFT_S * 1e3,
+                    help="TTFT budget for the SLO-debt table (ms)")
+    tp.add_argument("--chrome", default=None, metavar="OUT",
+                    help="also write a one-lane-per-request Chrome "
+                         "trace JSON here")
     args = p.parse_args(argv)
     if args.cmd == "report":
         print(render(args.run_dir, top=args.top))
+        return 0
+    if args.cmd == "trace":
+        print(render_trace(args.run_dir, top=args.top,
+                           tenant=args.tenant, stage=args.stage,
+                           slo_ttft_s=args.slo_ttft_ms / 1e3))
+        if args.chrome:
+            ct = chrome_trace(read_trace(args.run_dir))
+            with open(args.chrome, "w") as f:
+                json.dump(ct, f)
+            print(f"wrote {len(ct['traceEvents'])} request-trace "
+                  f"events to {args.chrome}")
         return 0
     out = args.output or f"{args.run_dir.rstrip('/')}/merged.trace.json"
     merged = merge_chrome_traces(args.run_dir)

@@ -69,8 +69,13 @@ class JsonlWriter:
     purpose: the one durability implementation behind both the Trainer's
     metric sinks (training/logging.py re-exports it) and EventLog."""
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, buffering: int = 1):
+        # buffering=1 (default) = line-buffered: one write syscall per
+        # row, durable through a crash. High-rate sinks whose readers
+        # tolerate a torn tail (request tracing) pass -1 for block
+        # buffering — a row becomes a memcpy, flushed on close().
         self.path = str(path)
+        self._buffering = buffering
         self._f = None
 
     def write(self, obj: dict) -> None:
@@ -80,7 +85,7 @@ class JsonlWriter:
         if self._f is None:
             parent = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(parent, exist_ok=True)
-            self._f = open(self.path, "a", buffering=1)
+            self._f = open(self.path, "a", buffering=self._buffering)
         self._f.write(line + "\n")
 
     def flush(self) -> None:

@@ -284,6 +284,23 @@ def _serving_section(run_dir: str) -> list[str]:
     return lines
 
 
+def _trace_section(run_dir: str, top: int) -> list[str]:
+    """The distributed request-trace table (ISSUE 17): the per-request
+    critical-path breakdown + per-tenant SLO-debt attribution merged
+    from the ``trace_rank*.jsonl`` files a traced fleet leaves behind.
+    Silent when the run never traced."""
+    import glob as _glob
+
+    from pytorchdistributed_tpu.telemetry.tracing import (
+        TRACE_GLOB,
+        render_trace,
+    )
+
+    if not _glob.glob(os.path.join(run_dir, TRACE_GLOB)):
+        return []
+    return render_trace(run_dir, top=top).splitlines()
+
+
 def _router_section(run_dir: str) -> list[str]:
     """The replica-router table (ISSUE 9): aggregate the
     ``router_metrics_rank*.jsonl`` streams a ReplicaRouter leaves behind
@@ -572,6 +589,12 @@ def render(run_dir: str | os.PathLike, *, top: int = 10) -> str:
     router = _router_section(run_dir)
     if router:
         lines.extend(router)
+        lines.append("")
+
+    # -- request traces (ISSUE 17) --------------------------------------------
+    traces = _trace_section(run_dir, top)
+    if traces:
+        lines.extend(traces)
         lines.append("")
 
     # -- host spans ----------------------------------------------------------

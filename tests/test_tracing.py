@@ -1,0 +1,430 @@
+"""Fleet-wide distributed request tracing (telemetry/tracing.py,
+ISSUE 17).
+
+Three layers, cheapest first:
+
+  * host units — TraceContext wire round-trip, tracer rows + the
+    unix-anchor clock mapping, the critical-path sweep's EXACT-tiling
+    invariant on synthetic spans, SLO-debt attribution, the Chrome
+    export, and the ``telemetry trace`` CLI;
+  * in-process fleet e2e — a disagg fleet (prefill role handing KV to
+    decode roles) with an injected mid-stream crash: every completed
+    request's spans form ONE connected trace whose per-stage sums tile
+    its terminal latency within 1 ms, across handoff AND failover;
+  * the off-means-off pin — tracing disabled leaves the router's event
+    stream identical (wall-clock stamp aside), writes no trace files,
+    and triggers zero fresh XLA traces.
+
+The subprocess wire e2e (workers exporting per-rank trace files joined
+across process boundaries) is full-tier only — it spawns jax-importing
+workers. Engine geometry mirrors tests/test_disagg.py so the compiled
+programs ride the suite's shared jit cache.
+"""
+
+import dataclasses
+import functools
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.faults.inject import FaultInjector, FaultPlan
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    KVBlockPayload,
+    ReplicaRouter,
+    SamplingParams,
+    kv_payload_from_wire,
+    kv_payload_to_wire,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.telemetry.tracing import (
+    STAGES,
+    TRACE_GLOB,
+    RequestTracer,
+    TraceContext,
+    chrome_trace,
+    critical_path,
+    critical_paths,
+    from_unix,
+    read_trace,
+    render_trace,
+    slo_debt,
+    to_unix,
+)
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    return model, params, dm
+
+
+def _ref(prompt, n):
+    _, params, dm = _setup()
+    return np.asarray(generate(dm, params, jnp.asarray(prompt)[None],
+                               max_new_tokens=n))[0]
+
+
+def _router(roles, run_dir, *, trace=True, faults=None, **kw):
+    model, params, _ = _setup()
+    router = ReplicaRouter(
+        model, params, replicas=len(roles), roles=roles,
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16, block_size=8),
+        warmup_lens=(16, 32), faults=faults,
+        telemetry_dir=str(run_dir), trace=trace, **kw)
+    router.warmup()
+    return router
+
+
+# ----------------------------------------------------------------------
+# host units (no jax work)
+
+
+def test_trace_context_wire_roundtrip():
+    tracer_free = TraceContext("abcd1234", "abcd1234/0")
+    wire = json.loads(json.dumps(tracer_free.to_wire()))
+    back = TraceContext.from_wire(wire)
+    assert back.trace_id == "abcd1234" and back.root == "abcd1234/0"
+    # absent / empty context on the wire -> no context, not a crash
+    assert TraceContext.from_wire(None) is None
+    assert TraceContext.from_wire({}) is None
+
+
+def test_tracer_rows_and_clock_anchor(tmp_path, monkeypatch):
+    t = RequestTracer(tmp_path, rank=3)
+    ctx = t.new_trace()
+    assert ctx.root == f"{ctx.trace_id}/0"
+    now = 1000.0   # a perf_counter reading
+    t.span(ctx, "request", now, now + 0.5, root=True,
+           request=7, tenant="a", ttft_s=0.1)
+    t.span(ctx, "queue", now, now + 0.1, replica=1)
+    t.span(None, "queue", now, now + 0.1)   # no context -> no row
+    t.close()
+    rows = read_trace(tmp_path)
+    assert len(rows) == 2
+    root = next(r for r in rows if r["parent"] is None)
+    stage = next(r for r in rows if r["parent"] is not None)
+    assert root["span"] == ctx.root and stage["parent"] == ctx.root
+    assert root["span"] != stage["span"]
+    assert stage["rank"] == 3 and stage["replica"] == 1
+    assert root["t1_us"] - root["t0_us"] == 500_000.0
+    # the anchor maps perf_counter <-> unix exactly (one process)
+    assert from_unix(to_unix(now)) == now
+    assert abs(root["t0_us"] / 1e6 - to_unix(now)) < 1e-3
+    # env-contract constructor: off by default, on with both vars
+    monkeypatch.delenv("PTD_TRACE", raising=False)
+    assert RequestTracer.from_env() is None
+    monkeypatch.setenv("PTD_TRACE", "1")
+    monkeypatch.delenv("PTD_TELEMETRY_DIR", raising=False)
+    assert RequestTracer.from_env() is None
+    monkeypatch.setenv("PTD_TELEMETRY_DIR", str(tmp_path))
+    t2 = RequestTracer.from_env(rank=5)
+    assert t2 is not None and t2.rank == 5
+    t2.close()
+    monkeypatch.setenv("PTD_TRACE", "0")
+    assert RequestTracer.from_env() is None
+
+
+def _synthetic_spans():
+    """One hand-built trace, times in ms from 0: queue [0,10],
+    admission [10,12], prefill [12,30], handoff [28,32] (overlaps the
+    prefill tail — the LATER-STARTING span owns the overlap), decode
+    [32,90], nothing covers [90,100]. TTFT = 40 ms."""
+    def row(span, parent, stage, a_ms, b_ms, **attrs):
+        return {"trace": "t1", "span": span, "parent": parent,
+                "stage": stage, "rank": 0,
+                "t0_us": a_ms * 1e3, "t1_us": b_ms * 1e3, **attrs}
+
+    return [
+        row("t1/0", None, "request", 0, 100, request=1, tenant="a",
+            ttft_s=0.040, finish_reason="length", retries=1),
+        row("r/1", "t1/0", "queue", 0, 10),
+        row("r/2", "t1/0", "admission", 10, 12),
+        row("0/1", "t1/0", "prefill", 12, 30),
+        row("r/3", "t1/0", "handoff", 28, 32),
+        row("1/1", "t1/0", "decode", 32, 90, rank=1),
+    ]
+
+
+def test_critical_path_exact_tiling_and_ttft_clip():
+    cp = critical_path(_synthetic_spans())
+    assert cp["connected"] and cp["spans"] == 6
+    assert cp["tenant"] == "a" and cp["retries"] == 1
+    want = {"queue": 10, "admission": 2, "prefill": 16, "handoff": 4,
+            "decode": 58}
+    for st, ms in want.items():
+        assert abs(cp[f"{st}_s"] * 1e3 - ms) < 1e-9, st
+    assert abs(cp["stall_s"] * 1e3 - 10) < 1e-9
+    # the invariant: stage sums + stall TILE the root window exactly
+    assert abs(sum(cp[f"{st}_s"] for st in STAGES)
+               + cp["stall_s"] - cp["total_s"]) < 1e-12
+    # TTFT window [0, 40ms]: decode only owns [32, 40]
+    assert abs(cp["ttft_decode_s"] * 1e3 - 8) < 1e-9
+    assert abs(cp["ttft_prefill_s"] * 1e3 - 16) < 1e-9
+    assert cp["ttft_stall_s"] == 0.0
+    # an orphan span breaks connectivity but not the math
+    spans = _synthetic_spans()
+    spans[1]["parent"] = "someone/else"
+    assert critical_path(spans)["connected"] is False
+    # no root span -> no path
+    assert critical_path(_synthetic_spans()[1:]) is None
+
+
+def test_slo_debt_attribution_and_tracer_ledger():
+    paths = critical_paths(_synthetic_spans())
+    assert len(paths) == 1
+    # budget above the 40 ms TTFT: no breach, no debt
+    clean = slo_debt(paths, slo_ttft_s=0.5)["a"]
+    assert clean["breaches"] == 0 and clean["debt_s"] == 0.0
+    # budget below it: one breach, debt = ttft - budget, and the
+    # breach-window attribution says which stage ate the TTFT
+    hot = slo_debt(paths, slo_ttft_s=0.01)["a"]
+    assert hot["breaches"] == 1
+    assert abs(hot["debt_s"] - 0.030) < 1e-9
+    assert abs(hot["ttft_prefill_s"] * 1e3 - 16) < 1e-9
+    # the tracer's live ledger (what the autoscaler snapshot reads)
+    t = RequestTracer.__new__(RequestTracer)   # no files needed
+    t.slo_ttft_s, t.slo_debt = 0.1, {}
+    assert t.debt_totals() == {}
+    t.note_finish("a", 0.05)
+    t.note_finish("a", None)
+    t.note_finish("b", 0.4)
+    t.note_finish("b", 0.2)
+    totals = t.debt_totals()
+    assert totals["slo_debt_tenant"] == "b"
+    assert abs(totals["slo_debt_s"] - 0.4) < 1e-6
+    assert t.slo_debt["a"]["breaches"] == 0
+    assert t.slo_debt["b"] == {"requests": 2, "breaches": 2,
+                               "debt_s": t.slo_debt["b"]["debt_s"]}
+
+
+def test_chrome_trace_lanes_and_tid_coercion():
+    rows = _synthetic_spans()
+    rows[1]["rank"] = "router"     # the router's rank is a string
+    ct = chrome_trace(rows)
+    json.dumps(ct)                 # must be valid Trace Event JSON
+    evs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert len(evs) == 6
+    assert all(e["pid"] == 0 for e in evs)       # one lane per trace
+    assert {e["tid"] for e in evs} == {-1, 0, 1}  # string rank -> -1
+    meta = [e for e in ct["traceEvents"] if e["ph"] == "M"]
+    assert meta and "req 1 (a)" in meta[0]["args"]["name"]
+
+
+def test_kv_payload_wire_carries_origin_and_trace():
+    """Satellite 1's wire half: the handoff payload round-trips the
+    ORIGIN submit stamp and the TraceContext — and a pre-ISSUE-17 wire
+    dict (neither key) still decodes, as None."""
+    payload = KVBlockPayload(
+        prompt=np.arange(5, dtype=np.int32), generated=[3, 1],
+        true_len=5, block_size=8, max_new_tokens=4,
+        sampling=SamplingParams(), stop_ids=(),
+        leaves=[("k", np.zeros((1, 2), np.float32))],
+        origin_t=1234.5, trace={"trace_id": "t", "root": "t/0"})
+    d = json.loads(json.dumps(kv_payload_to_wire(payload)))
+    back = kv_payload_from_wire(d)
+    assert back.origin_t == 1234.5
+    assert back.trace == {"trace_id": "t", "root": "t/0"}
+    legacy = {k: v for k, v in d.items()
+              if k not in ("origin_t", "trace")}
+    old = kv_payload_from_wire(legacy)
+    assert old.origin_t is None and old.trace is None
+
+
+def test_trace_cli_and_report_section(tmp_path, capsys):
+    from pytorchdistributed_tpu.telemetry.__main__ import main
+    from pytorchdistributed_tpu.telemetry.report import render
+
+    # a dir with NO trace files: report stays silent, CLI says so
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert "request traces" not in render(empty)
+    assert main(["trace", str(empty)]) == 0
+    assert "none found" in capsys.readouterr().out
+    # two tenants, one slow outlier, written through the real tracer
+    t = RequestTracer(tmp_path, rank="router")
+    for i, (tenant, total) in enumerate(
+            [("hot", 0.9), ("hot", 0.05), ("calm", 0.06)]):
+        ctx = t.new_trace()
+        t.span(ctx, "request", 0.0, total, root=True, request=i,
+               tenant=tenant, ttft_s=total * 0.9,
+               finish_reason="length")
+        t.span(ctx, "queue", 0.0, total * 0.5, replica=0)
+        t.span(ctx, "decode", total * 0.5, total, replica=0)
+    t.close()
+    out_path = tmp_path / "req.trace.json"
+    assert main(["trace", str(tmp_path), "--top", "2",
+                 "--slo-ttft-ms", "100",
+                 "--chrome", str(out_path)]) == 0
+    out = capsys.readouterr().out
+    assert "3 requests" in out and "3/3 connected" in out
+    assert "hot" in out and "calm" in out
+    assert json.load(open(out_path))["traceEvents"]
+    # --tenant filters; --stage reranks
+    assert main(["trace", str(tmp_path), "--tenant", "calm",
+                 "--stage", "queue"]) == 0
+    out = capsys.readouterr().out
+    assert "1 requests" in out and "slowest by queue" in out
+    # the run report grows the same table without breaking its layout
+    rep = render(tmp_path)
+    assert "request traces" in rep and "SLO debt" in rep
+    # fed to the autoscaler's decision snapshot via the same ledger
+    assert render_trace(tmp_path).startswith("request traces")
+
+
+# ----------------------------------------------------------------------
+# in-process fleet e2e (shared jit cache with test_disagg geometry)
+
+
+def test_fleet_trace_connected_across_handoff_and_failover(tmp_path):
+    """The acceptance run, in-process: a disagg fleet (1 prefill -> 2
+    decode) with replica 1 crashed mid-stream. Every COMPLETED request
+    has one connected span chain whose per-stage sums tile its terminal
+    latency within 1 ms — handoffs and the failover redispatch
+    included — and the handed-off streams' e2e TTFT measures from the
+    ORIGIN submit (satellite 1)."""
+    inj = FaultInjector(FaultPlan.parse("replica_crash@tick=9,replica=1"))
+    router = _router([ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE], tmp_path,
+                     faults=inj)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+               for m in (5, 9, 7, 11, 6, 8)]
+    reqs = [router.submit(p, max_new_tokens=10, tenant=f"t{i % 2}")
+            for i, p in enumerate(prompts)]
+    router.run_until_idle()
+    s = router.summary()
+    router.close()
+    assert s["handoffs"] >= 1 and s["failovers"] >= 1
+    assert s["redispatched_requests"] >= 1
+    rows = read_trace(tmp_path)
+    paths = {p["request"]: p for p in critical_paths(rows)}
+    done = [r for r in reqs if r.finish_reason in ("length", "stop")]
+    assert done and len(paths) == len(reqs)
+    for r in done:
+        p = paths[r.id]
+        assert p["connected"], f"request {r.id} has orphan spans"
+        terminal = r.finish_time - r.submit_time
+        stage_sum = sum(p[f"{st}_s"] for st in STAGES) + p["stall_s"]
+        assert abs(stage_sum - terminal) < 1e-3, \
+            f"request {r.id}: {stage_sum} vs {terminal}"
+        assert abs(p["total_s"] - terminal) < 1e-3
+    # the handoff stage is visible in at least one breakdown, and the
+    # failover left a redispatch marker in the raw spans
+    assert any(p["handoff_s"] > 0 for p in paths.values())
+    assert any(r.get("stage") == "redispatch" for r in rows)
+    # satellite 1: a handed-off stream's decode-local TTFT collapses to
+    # ~0 at import, but its e2e TTFT (origin router submit -> first
+    # token) survives the wire
+    serve_rows = []
+    for i in range(3):
+        f = tmp_path / f"serve_metrics_rank{i}.jsonl"
+        if f.exists():
+            serve_rows += [json.loads(line) for line in open(f)]
+    req_rows = [r for r in serve_rows if r.get("kind") == "request"]
+    assert any(r["ttft_e2e_ms"] is not None
+               and r["ttft_ms"] is not None
+               and r["ttft_e2e_ms"] > r["ttft_ms"] + 0.5
+               for r in req_rows), "no row shows e2e > decode-local TTFT"
+
+
+def test_tracing_off_is_off(tmp_path):
+    """Off means OFF: the same deterministic disagg workload run with
+    tracing off vs on — the off run writes no trace files, both runs
+    trigger ZERO fresh XLA traces, and the router's event rows are
+    identical (the wall-clock ``time`` stamp aside)."""
+    import glob as _glob
+
+    def run(sub, trace_on):
+        d = tmp_path / sub
+        router = _router([ROLE_PREFILL, ROLE_DECODE], d, trace=trace_on)
+        traces0 = dict(serving_engine.TRACE_COUNTS)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+                   for m in (5, 9, 12)]
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle()
+        recompiles = (sum(serving_engine.TRACE_COUNTS.values())
+                      - sum(traces0.values()))
+        router.close()
+        assert all(r.finish_reason == "length" for r in reqs)
+        # request ids are process-global: normalize to submit order so
+        # the two runs' event rows compare field-for-field
+        id_map = {r.id: i for i, r in enumerate(reqs)}
+        events = []
+        for line in open(d / "router_metrics_rank0.jsonl"):
+            row = json.loads(line)
+            if row.get("kind") == "event":
+                row.pop("time")
+                if "request" in row:
+                    row["request"] = id_map.get(row["request"],
+                                                row["request"])
+                events.append(row)
+        return d, recompiles, events
+
+    d_off, rec_off, ev_off = run("off", False)
+    d_on, rec_on, ev_on = run("on", True)
+    assert rec_off == 0 and rec_on == 0
+    assert _glob.glob(str(d_off / TRACE_GLOB)) == []
+    assert _glob.glob(str(d_on / TRACE_GLOB)) != []
+    assert ev_off == ev_on
+    # and the tokens never depend on the tracer either way
+    assert len(critical_paths(read_trace(d_on))) == 3
+
+
+# ----------------------------------------------------------------------
+# subprocess wire (full-suite-only: spawns jax-importing workers)
+
+
+def test_subprocess_trace_connected_over_wire(tmp_path, monkeypatch):
+    """The multi-host shape: PTD_TRACE=1 + a telemetry dir makes the
+    router AND both subprocess workers write per-rank trace files; the
+    TraceContext rides the submit op and the KV handoff payload, so the
+    merged trace is connected across real process boundaries."""
+    monkeypatch.setenv("PTD_TRACE", "1")
+    monkeypatch.setenv("PTD_TELEMETRY_DIR", str(tmp_path))
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 3, "prefill_bucket": 16,
+                       "block_size": 8}}
+    router = ReplicaRouter(workers=[spec, spec],
+                           roles=[ROLE_PREFILL, ROLE_DECODE],
+                           warmup_lens=(16, 32), faults=None,
+                           telemetry_dir=str(tmp_path))
+    try:
+        router.warmup()
+        rng = np.random.default_rng(31)
+        prompts = [rng.integers(0, CFG.vocab_size, (m,)).astype(np.int32)
+                   for m in (5, 9, 12)]
+        reqs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.run_until_idle(max_steps=200000)
+        s = router.summary()
+        assert s["handoffs"] == 3 and s["handoff_failures"] == 0
+        for p, r in zip(prompts, reqs):
+            assert r.finish_reason == "length"
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens), _ref(p, 6)[p.size:],
+                err_msg=f"request {r.id}")
+    finally:
+        router.close()
+    rows = read_trace(tmp_path)
+    ranks = {r["rank"] for r in rows}
+    assert "router" in ranks and 0 in ranks and 1 in ranks
+    paths = critical_paths(rows)
+    assert len(paths) == 3
+    for p in paths:
+        assert p["connected"], f"request {p['request']}: orphan spans"
+        assert p["handoff_s"] > 0
+        # engine-side spans from BOTH workers joined the router's trace
+        stage_sum = sum(p[f"{st}_s"] for st in STAGES) + p["stall_s"]
+        assert abs(stage_sum - p["total_s"]) < 1e-6
