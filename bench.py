@@ -1558,6 +1558,185 @@ def bench_autoscale() -> dict:
     return result
 
 
+def bench_sessions() -> dict:
+    """Persistent sessions + the tiered KV hierarchy (ISSUE 18).
+
+    Two measurements on the suite-shared test geometry:
+
+      * ``reattach_ab`` — the headline A/B: N long-history sessions
+        parked in the store's host-DRAM tier, each resumed on a FRESH
+        engine two ways at identical geometry — ``session_id=`` reattach
+        (seed the saved blocks, prefill only the new user tokens + the
+        partial tail block) vs the full-history re-prefill a sessionless
+        server pays. Every session's tokens are distinct so the
+        re-prefill leg can't ride radix reuse — it measures the
+        KV-is-gone path, which is exactly what reattach replaces.
+        Headline = p50 TTFT ratio (re-prefill / reattach; > 1 =
+        sessions win, acceptance floor 3x). Both legs step the same
+        compiled programs; ``recompiles`` (fresh XLA traces after
+        warmup, across ALL legs) must stamp 0.
+      * ``fleet`` — the satellite-1 multi-turn conversation mix
+        (seeded think-time gaps) replayed through a 2-replica sessioned
+        router on the fake clock: stamps per-tier reattach counts,
+        fallbacks, demote sweeps and the store's tier occupancy.
+
+    ``sessions_per_gb`` derives capacity per tier from the measured
+    mean payload size: host-DRAM and disk hold the wire payload
+    (int8-aware — PTD_QUANT=int8 shrinks it ~2x), HBM holds the raw
+    resident blocks. Knobs: PTD_SESS_{N,HIST,NEW,SLOTS,BLOCK,SEQ};
+    PTD_QUANT rides the model config like every serving bench."""
+    import os
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchdistributed_tpu.models import GPT2, gpt2_config
+    from pytorchdistributed_tpu.serving import (
+        ReplicaRouter,
+        ServingEngine,
+        SessionStore,
+        make_conversations,
+        replay_conversations,
+    )
+    from pytorchdistributed_tpu.serving import engine as serving_engine
+
+    n_sessions = int(os.environ.get("PTD_SESS_N", "12"))
+    hist_len = int(os.environ.get("PTD_SESS_HIST", "224"))
+    new_len = int(os.environ.get("PTD_SESS_NEW", "8"))
+    num_slots = int(os.environ.get("PTD_SESS_SLOTS", "4"))
+    block = int(os.environ.get("PTD_SESS_BLOCK", "8"))
+    seq = int(os.environ.get("PTD_SESS_SEQ", "256"))
+    cfg = gpt2_config("test", num_layers=2, max_seq_len=seq,
+                      quant=_quant_override())
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    kv_dtype = "int8" if cfg.quant == "int8" else None
+    ekw = dict(num_slots=num_slots, prefill_bucket=16, block_size=block)
+    if kv_dtype:
+        ekw["kv_dtype"] = kv_dtype
+
+    def build(store=None, hbm_max=4):
+        e = ServingEngine(model, params, session_store=store,
+                          session_hbm_max=hbm_max, **ekw)
+        e.warmup(prompt_lens=(16, 32))
+        e.warmup_kv_stream()
+        return e
+
+    rng = np.random.default_rng(18)
+    hists = [rng.integers(1, cfg.vocab_size, hist_len).astype(np.int32)
+             for _ in range(n_sessions)]
+    news = [rng.integers(1, cfg.vocab_size, new_len).astype(np.int32)
+            for _ in range(n_sessions)]
+
+    # -- park N sessions into the store's DRAM tier ---------------------
+    store = SessionStore(None, dram_bytes=1 << 30)
+    builder = build(store=store, hbm_max=1)  # each park demotes the last
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    resumes = []
+    for i, hist in enumerate(hists):
+        h = builder.submit(hist, max_new_tokens=4,
+                           session_id=f"sess-{i}")
+        builder.run_until_idle()
+        resumes.append(np.concatenate(
+            [hist, np.asarray(h.new_tokens, np.int32), news[i]]))
+    sess_summary = builder.summary()["sessions"]
+    hbm_bytes_per = (sess_summary["resident_bytes"]
+                     / max(sess_summary["resident"], 1))
+    builder.close()
+    payload_bytes = [store._dram[f"sess-{i}"].payload.nbytes
+                     for i in range(n_sessions)
+                     if f"sess-{i}" in store._dram]
+
+    # -- A/B: reattach vs full re-prefill on fresh engines --------------
+    def ttft(engine, prompt, **kw):
+        t0 = time.perf_counter()
+        h = engine.submit(prompt, max_new_tokens=4, **kw)
+        while not h.new_tokens and not h.done:
+            engine.step()
+        dt = time.perf_counter() - t0
+        engine.run_until_idle()
+        return dt * 1e3
+
+    reattach_e = build(store=store, hbm_max=n_sessions + 1)
+    reprefill_e = build()
+    re_ms, full_ms = [], []
+    for i, prompt in enumerate(resumes):
+        re_ms.append(ttft(reattach_e, prompt, session_id=f"sess-{i}"))
+        full_ms.append(ttft(reprefill_e, prompt))
+    seeded_tokens = reattach_e.summary()["sessions"]["seed_tokens"]
+    reattach_e.close()
+    reprefill_e.close()
+    store_stats = store.stats()
+    store.close()
+    p50_re = float(np.percentile(re_ms, 50))
+    p50_full = float(np.percentile(full_ms, 50))
+
+    # -- fleet leg: the multi-turn conversation mix ---------------------
+    convs = make_conversations(seed=18, duration_s=6.0,
+                               session_rate=0.8,
+                               vocab_size=cfg.vocab_size,
+                               turns_cap=4, turn_cap=12, new_cap=6,
+                               think_mean_s=0.3)
+    fstore = SessionStore(None, dram_bytes=1 << 30)
+    router = ReplicaRouter(
+        model, params, replicas=2,
+        engine_kwargs=dict(session_hbm_max=2, **ekw),
+        warmup_lens=(16, 32), session_store=fstore, faults=None)
+    router.warmup()
+    out = replay_conversations(router, convs, tick_s=0.02,
+                               max_seq_len=cfg.max_seq_len)
+    fsum = router.summary()["sessions"]
+    router.close()
+    fstore.close()
+    recompiles = (sum(serving_engine.TRACE_COUNTS.values())
+                  - sum(traces0.values()))
+
+    mean_payload = float(np.mean(payload_bytes)) if payload_bytes else 0
+    result = {
+        "metric": "session_reattach_ttft_speedup_p50",
+        "value": round(p50_full / p50_re, 2) if p50_re else None,
+        "unit": "x (re-prefill / reattach; > 1 = sessions win)",
+        "reattach_ab": {
+            "sessions": n_sessions, "history_tokens": hist_len,
+            "new_tokens_per_turn": new_len,
+            "reattach_ttft_ms_p50": round(p50_re, 3),
+            "reprefill_ttft_ms_p50": round(p50_full, 3),
+            "reattach_ttft_ms_p99": round(
+                float(np.percentile(re_ms, 99)), 3),
+            "reprefill_ttft_ms_p99": round(
+                float(np.percentile(full_ms, 99)), 3),
+            "seeded_tokens": seeded_tokens,
+            "store_hits_dram": store_stats["hits_dram"],
+        },
+        "sessions_per_gb": {
+            "payload_bytes_mean": round(mean_payload),
+            "dram_or_disk": (round(1e9 / mean_payload)
+                             if mean_payload else None),
+            "hbm_resident_bytes_per_session": round(hbm_bytes_per),
+            "hbm": (round(1e9 / hbm_bytes_per)
+                    if hbm_bytes_per else None),
+        },
+        "fleet": {
+            "conversations": len(convs),
+            "turns": sum(len(v) for v in out.values()),
+            "reattach": fsum["reattach"],
+            "fallbacks": fsum["fallbacks"],
+            "demotes": fsum["demotes"],
+            "ships": fsum["ships"],
+        },
+        "num_slots": num_slots, "block_size": block,
+        "max_seq_len": seq, "kv_dtype": kv_dtype or "bf16",
+        "recompiles": recompiles,
+    }
+    _stamp_overrides(result, ("PTD_SESS_N", "PTD_SESS_HIST",
+                              "PTD_SESS_NEW", "PTD_SESS_SLOTS",
+                              "PTD_SESS_BLOCK", "PTD_SESS_SEQ",
+                              "PTD_QUANT"))
+    return result
+
+
 def _trace_overhead_ab() -> dict:
     """Request-tracing on/off A/B (ISSUE 17 satellite): the SAME seeded
     traffic.py trace replayed through two identical warmed in-process
@@ -2425,6 +2604,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "serve": bench_serve, "kvcompress": bench_kvcompress,
            "specdraft": bench_specdraft,
            "router": bench_router, "autoscale": bench_autoscale,
+           "sessions": bench_sessions,
            "disagg": bench_disagg, "coldstart": bench_coldstart,
            "moe": bench_moe,
            "mlp": bench_mlp, "sweep": bench_sweep,

@@ -321,6 +321,31 @@ _QUICK = (
     "test_tracing.py::test_trace_cli_and_report_section",
     "test_tracing.py::test_fleet_trace_connected_across_handoff_and_failover",
     "test_tracing.py::test_tracing_off_is_off",
+    # persistent sessions + tiered KV hierarchy (ISSUE 18): the store
+    # tier/LRU/tenant-cap/corruption/CLI units and the FleetSessionIndex
+    # + conversation-generator units are pure host work (<0.1 s); the
+    # engine/router anchors (park/adopt/demote/store reattach bitwise,
+    # kv_window wire carry, export/seed ship, all-tiers router flow +
+    # restart, drain cross-replica reattach, conversation replay, int8
+    # + seeded store round-trip) ride the suite-shared test-size
+    # geometry and the programs test_paging/test_router/test_disagg
+    # already compiled — ~25 s incremental, warm. The SUBPROCESS wire
+    # e2e (spawns jax-importing workers) stays full-suite-only.
+    "test_sessions.py::test_session_id_validation",
+    "test_sessions.py::test_fleet_session_index_units",
+    "test_sessions.py::test_store_lru_demotion_and_tenant_caps",
+    "test_sessions.py::test_store_restart_corruption_torn_and_version",
+    "test_sessions.py::test_store_cli_ls_verify_gc",
+    "test_sessions.py::test_conversation_generator_determinism",
+    "test_sessions.py::test_engine_and_router_session_walls",
+    "test_sessions.py::test_engine_sessions_park_adopt_store_bitwise",
+    "test_sessions.py::test_parked_sessions_never_deadlock_admission",
+    "test_sessions.py::test_engine_sessions_seeded_and_int8_bitwise",
+    "test_sessions.py::test_kv_window_override_rides_wire",
+    "test_sessions.py::test_replica_ship_export_seed_bitwise",
+    "test_sessions.py::test_router_sessions_all_tiers_bitwise",
+    "test_sessions.py::test_router_cross_replica_reattach_when_owner_drains",
+    "test_sessions.py::test_conversation_replay_drives_reattaches",
 )
 
 

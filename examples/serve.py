@@ -98,6 +98,14 @@ def main():
                              "scheduler keeps the token split weighted-"
                              "fair, and the summary prints the per-"
                              "tenant table")
+    parser.add_argument("--sessions", action="store_true",
+                        help="multi-turn demo (README 'Persistent "
+                             "sessions & KV tiering'): a seeded "
+                             "conversation mix replayed through a "
+                             "sessioned router — later turns REATTACH "
+                             "the parked KV (HBM or the store's DRAM "
+                             "tier) instead of re-prefilling; implies "
+                             "the paged engine and the router path")
     parser.add_argument("--trace", action="store_true",
                         help="fleet-wide request tracing (README "
                              "'Distributed request tracing'): every "
@@ -112,6 +120,12 @@ def main():
                              "its streams to a survivor with the SAME "
                              "tokens")
     args = parser.parse_args()
+    if args.sessions:
+        if args.autoscale:
+            parser.error("--sessions and --autoscale are separate "
+                         "demos — run them one at a time")
+        if not args.block_size:
+            args.block_size = 16  # sessions require the paged engine
     if args.trace and not args.telemetry_dir:
         parser.error("--trace needs --telemetry-dir (spans are "
                      "trace_rank*.jsonl files in the run dir)")
@@ -180,7 +194,8 @@ def main():
                                               args.draft_layers)
         spec_kw = dict(draft_config=draft.cfg, draft_params=draft_params)
 
-    if args.replicas > 1 or args.autoscale or args.tenants or args.trace:
+    if (args.replicas > 1 or args.autoscale or args.tenants
+            or args.trace or args.sessions):
         # REPLICATED serving (ISSUE 9): the router owns N engines,
         # balances on their health snapshots and — with --chaos — shows
         # lossless mid-stream failover: the crashed replica's streams
@@ -211,6 +226,15 @@ def main():
                     f"replica=0")
             print(f"--- chaos armed: {spec} ---")
             router_kw["faults"] = FaultInjector(FaultPlan.parse(spec))
+        store = None
+        if args.sessions:
+            # persistent sessions (ISSUE 18): the router owns the
+            # host-DRAM store tier; engines park finished session
+            # streams in HBM and demote the eldest into it
+            from pytorchdistributed_tpu.serving import SessionStore
+
+            store = SessionStore(None, dram_bytes=64 << 20)
+            router_kw["session_store"] = store
         names = ["default"]
         if args.tenants:
             # equal WDRR weights: fairness comes from the scheduler,
@@ -286,6 +310,34 @@ def main():
             print(f"served {done}/{len(reqs)} "
                   f"(shed {sum(1 for r in reqs if r.finish_reason == 'shed')})")
             print("autoscaler summary:", asc.summary())
+        elif args.sessions:
+            # a seeded multi-turn mix on the fake-clock replay driver:
+            # each turn submits only after the previous finished and
+            # its think gap elapsed, carrying the full history — later
+            # turns reattach the parked KV instead of re-prefilling
+            from pytorchdistributed_tpu.serving import (
+                make_conversations,
+                replay_conversations,
+            )
+
+            convs = make_conversations(
+                seed=0, duration_s=6.0, session_rate=0.8,
+                vocab_size=cfg.vocab_size, turns_cap=4, turn_cap=10,
+                new_cap=6, think_mean_s=0.3)
+            print(f"--- {len(convs)} conversations, "
+                  f"{sum(len(c.turns) for c in convs)} turns ---")
+            out = replay_conversations(router, convs, tick_s=0.02,
+                                       max_seq_len=cfg.max_seq_len)
+            for c in convs:
+                for t, r in enumerate(out[c.session_id]):
+                    hops = "->".join(map(str, r.replicas))
+                    print(f"  {c.session_id} turn {t} (replica {hops},"
+                          f" {r.finish_reason}): "
+                          f"{len(r.prompt)} ctx -> {list(r.tokens)}")
+            sess = router.summary().get("sessions", {})
+            print(f"session reattaches {sess.get('reattach')} "
+                  f"fallbacks {sess.get('fallbacks')} "
+                  f"demotes {sess.get('demotes')}")
         else:
             reqs = []
             for i in range(args.requests):
@@ -307,6 +359,9 @@ def main():
                       f"{r.prompt.tolist()} -> {r.tokens}")
         print("router summary:", router.summary())
         router.close()
+        if store is not None:
+            print("session store:", store.stats())
+            store.close()
         if args.trace:
             from pytorchdistributed_tpu.telemetry.tracing import (
                 render_trace,

@@ -40,9 +40,17 @@
                    remove_replica, with hysteresis, cooldowns and
                    independent prefill/decode pool scaling
   * traffic.py   — seeded trace generators (steady/diurnal/flash,
-                   heavy-tail lengths, shared-prefix tenant mixes) and
-                   the fake-clock replay() driver the bench and the
-                   quick test tier share
+                   heavy-tail lengths, shared-prefix tenant mixes,
+                   multi-turn conversations with think-time gaps) and
+                   the fake-clock replay()/replay_conversations()
+                   drivers the bench and the quick test tier share
+  * sessions.py  — SessionStore (ISSUE 18): the host-DRAM + disk tiers
+                   of the persistent-session KV hierarchy (manifest-
+                   verified disk sessions, quarantine-on-corruption,
+                   per-tenant caps, offline ls/verify/gc CLI); engines
+                   park finished session streams in HBM, the router's
+                   FleetSessionIndex steers reattaching turns to the
+                   owner or pulls/seeds the payload over the wire
 
 `bench.py --mode serve` drives it under a Poisson arrival trace (plus
 the paged capacity, prefix-reuse and autoscale A/Bs); examples/serve.py
@@ -78,6 +86,7 @@ from pytorchdistributed_tpu.serving.engine import (  # noqa: F401
 from pytorchdistributed_tpu.serving.paging import (  # noqa: F401
     BlockAllocator,
     FleetPrefixIndex,
+    FleetSessionIndex,
     RadixPrefixCache,
     block_hashes,
 )
@@ -106,10 +115,18 @@ from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
     ServingTelemetry,
     SignalRing,
 )
+from pytorchdistributed_tpu.serving.sessions import (  # noqa: F401
+    SessionStore,
+    session_id_ok,
+)
 from pytorchdistributed_tpu.serving.traffic import (  # noqa: F401
+    Conversation,
+    ConversationTurn,
     FakeClock,
     TenantTraffic,
     TrafficRequest,
+    make_conversations,
     make_trace,
     replay,
+    replay_conversations,
 )

@@ -73,12 +73,17 @@ class TenantConfig:
       (prompt + budget); None = uncapped.
     burst_s: bucket depth in seconds of the rate — how far above the
       sustained rate a burst may momentarily go.
+    max_sessions: per-tenant cap on PERSISTENT sessions parked in the
+      tiered KV store (ISSUE 18); past it the tenant's own coldest
+      session evicts — one tenant's long-lived conversations can never
+      squeeze another's out of the warm tiers. None = uncapped.
     """
 
     weight: float = 1.0
     max_queued: int | None = None
     rate_tokens_per_s: float | None = None
     burst_s: float = 2.0
+    max_sessions: int | None = None
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -86,6 +91,9 @@ class TenantConfig:
         if self.max_queued is not None and self.max_queued < 1:
             raise ValueError(
                 f"max_queued must be >= 1, got {self.max_queued}")
+        if self.max_sessions is not None and self.max_sessions < 1:
+            raise ValueError(
+                f"max_sessions must be >= 1, got {self.max_sessions}")
         if (self.rate_tokens_per_s is not None
                 and self.rate_tokens_per_s <= 0):
             raise ValueError(f"rate_tokens_per_s must be > 0, got "

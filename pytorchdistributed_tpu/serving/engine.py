@@ -582,6 +582,13 @@ class KVBlockPayload:
     # the request's TraceContext wire dict — the handoff keeps the
     # stream on ONE connected trace across replicas
     trace: dict | None = None
+    # per-request sliding-window override (ISSUE 18 satellite): the
+    # EFFECTIVE kv_sink/kv_window the exporting slot ran under, so a
+    # reattached/handed-off stream keeps its tightened mask — without
+    # these, retired-block positions (gathered as trash) would be
+    # ATTENDED on the importer. None = the importer's pool defaults
+    kv_sink: int | None = None
+    kv_window: int | None = None
 
     @property
     def num_blocks(self) -> int:
@@ -655,7 +662,8 @@ def kv_payload_to_wire(p: KVBlockPayload) -> dict:
                 stop_ids=list(p.stop_ids),
                 leaves=_leaves_to_wire(p.leaves),
                 kv_dtype=p.kv_dtype, wire_version=p.wire_version,
-                origin_t=p.origin_t, trace=p.trace)
+                origin_t=p.origin_t, trace=p.trace,
+                kv_sink=p.kv_sink, kv_window=p.kv_window)
 
 
 def kv_payload_from_wire(d: dict) -> KVBlockPayload:
@@ -671,7 +679,13 @@ def kv_payload_from_wire(d: dict) -> KVBlockPayload:
         # importer's version check names the mismatch instead of KeyError
         kv_dtype=str(d.get("kv_dtype", "bf16")),
         wire_version=int(d.get("wire_version", 1)),
-        origin_t=d.get("origin_t"), trace=d.get("trace"))
+        origin_t=d.get("origin_t"), trace=d.get("trace"),
+        # absent on pre-ISSUE-18 senders: None = pool defaults, the
+        # exact pre-18 behavior
+        kv_sink=(None if d.get("kv_sink") is None
+                 else int(d["kv_sink"])),
+        kv_window=(None if d.get("kv_window") is None
+                   else int(d["kv_window"])))
 
 
 def prefix_payload_to_wire(p: PrefixBlockPayload) -> dict:
@@ -749,6 +763,12 @@ class Request:
         # engine-static defaults
         self.kv_window: int | None = None
         self.kv_sink: int | None = None
+        # persistent sessions (ISSUE 18): a tagged stream's KV parks in
+        # the engine's HBM-resident session tier at retirement instead
+        # of freeing; ``tenant`` rides along for the store's per-tenant
+        # session budgets
+        self.session_id: str | None = None
+        self.tenant: str = "default"
         # distributed tracing (ISSUE 17): the router-minted
         # TraceContext this request's engine-side spans attach to, and
         # the ORIGIN router submit mapped onto THIS process's
@@ -928,7 +948,8 @@ class ServingEngine:
                  compile_cache="auto", kv_dtype: str | None = None,
                  kv_sink_tokens: int | None = None,
                  kv_window_tokens: int | None = None,
-                 paged_attn: str | None = None, trace=None):
+                 paged_attn: str | None = None, trace=None,
+                 session_store=None, session_hbm_max: int = 4):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
@@ -1046,6 +1067,18 @@ class ServingEngine:
             # kv_windows leaves too (Flax init defaults never run), so
             # the engine defaults must be stamped before the first tick
             self._limits_dirty = self.per_slot_limits
+            # persistent sessions (ISSUE 18): the HBM-RESIDENT tier —
+            # finished session streams keyed by session_id, each
+            # holding its slot's block list (refcounts transferred off
+            # the slot at retirement). dict order == LRU; past
+            # ``session_hbm_max`` the eldest demotes into a
+            # KVBlockPayload (gather — the existing AOT program) bound
+            # for ``session_store`` (host-DRAM/disk tiers) or the
+            # spill queue a router drains over the wire
+            self._sessions: dict[str, dict] = {}
+            self._session_spill: list[tuple[str, str, KVBlockPayload]] = []
+        self.session_store = session_store
+        self.session_hbm_max = max(0, int(session_hbm_max))
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.spec_k = spec_k
@@ -1189,7 +1222,9 @@ class ServingEngine:
                generated=None, prefill_only: bool = False,
                kv_window: int | None = None,
                kv_sink: int | None = None,
-               trace=None, origin_t: float | None = None) -> Request:
+               trace=None, origin_t: float | None = None,
+               session_id: str | None = None,
+               tenant: str = "default") -> Request:
         """Queue one request; returns its handle (tokens stream into
         ``handle.new_tokens`` / the on_token callback as the engine
         steps). ``stop_ids`` accepts a single id or a sequence.
@@ -1229,10 +1264,19 @@ class ServingEngine:
         and the retirement sweep frees the request's dead blocks at
         its own tighter horizon. Requires a windowed gather-path pool:
         a dense engine, a windowless pool (there are no mask leaves to
-        stamp — the compiled programs are exactly PR 12's), the Pallas
-        kernel (sink/window are STATIC kernel parameters there) and
-        ``prefill_only`` handoffs (the KV wire carries no per-request
-        window) all reject loudly."""
+        stamp — the compiled programs are exactly PR 12's) and the
+        Pallas kernel (sink/window are STATIC kernel parameters there)
+        all reject loudly. The KV handoff wire CARRIES the effective
+        override (ISSUE 18), so a ``prefill_only`` stream keeps its
+        tightened mask on the decode replica.
+
+        ``session_id`` (ISSUE 18) tags the stream as a persistent
+        SESSION: at retirement its KV blocks park in the engine's
+        HBM-resident session tier instead of freeing, and a later
+        submit with the same id rides them as a radix prefix hit (or
+        pulls them back up from the attached ``session_store``'s
+        host-DRAM/disk tiers). ``tenant`` rides along for the store's
+        per-tenant session budgets."""
         if kv_window is not None or kv_sink is not None:
             if not self.paged:
                 raise ValueError(
@@ -1248,10 +1292,6 @@ class ServingEngine:
                     "per-request kv_window/kv_sink need paged_attn="
                     "'gather' — the Pallas kernel takes sink/window as "
                     "STATIC parameters")
-            if prefill_only:
-                raise ValueError(
-                    "per-request kv_window/kv_sink do not ride the KV "
-                    "handoff wire — submit them on the decode replica")
             if kv_window is not None and kv_window < 1:
                 raise ValueError(
                     f"kv_window must be >= 1, got {kv_window}")
@@ -1266,6 +1306,21 @@ class ServingEngine:
                 raise ValueError(
                     "prefill_only does not compose with spec_k > 0 "
                     "(the draft pool is not on the KV stream)")
+        if session_id is not None:
+            if not self.paged:
+                raise ValueError(
+                    "session_id requires the paged engine "
+                    "(block_size > 0): sessions park KV blocks")
+            if self.spec_k:
+                raise ValueError(
+                    "session_id does not compose with spec_k > 0 "
+                    "(the draft pool is not on the session tier)")
+            from pytorchdistributed_tpu.serving.sessions import \
+                session_id_ok
+            if not session_id_ok(session_id):
+                raise ValueError(
+                    f"malformed session_id {session_id!r} (want "
+                    f"[A-Za-z0-9][A-Za-z0-9._:-]*, <= 128 chars)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -1289,16 +1344,18 @@ class ServingEngine:
                       deadline_s=deadline_s, generated=generated)
         req.prefill_only = prefill_only
         if kv_window is not None or kv_sink is not None:
-            # clamp to the pool config (tighten-only) and round UP to
-            # whole blocks — retirement frees whole blocks, and a
-            # window shorter than one block would retire the block the
-            # next write needs
-            bs = self.block_size
-            win = self.kv_window_tokens if kv_window is None else kv_window
-            win = min(self.kv_window_tokens, self._round_up(win, bs))
-            sink = self.kv_sink_tokens if kv_sink is None else kv_sink
-            sink = min(self.kv_sink_tokens, self._round_up(sink, bs))
-            req.kv_window, req.kv_sink = int(win), int(sink)
+            req.kv_sink, req.kv_window = self._clamp_limits(
+                kv_sink, kv_window)
+        req.session_id = session_id
+        req.tenant = str(tenant)
+        if session_id is not None:
+            # reattach (ISSUE 18): a parked resident session's blocks
+            # publish into the radix (turn-2 prefill rides them as a
+            # prefix hit, bitwise-equal to a full prefill); a session
+            # in the store's host-DRAM/disk tiers seeds its full
+            # blocks back into the pool the same way. A miss or a
+            # declined tier just means a plain re-prefill — lossless.
+            self._reattach_session(session_id)
         req.submit_time = time.perf_counter()
         # distributed tracing + origin timestamp (ISSUE 17): ``trace``
         # is the router-minted TraceContext (a wire dict from the
@@ -1517,6 +1574,22 @@ class ServingEngine:
     def _round_up(n: int, q: int) -> int:
         return -(-n // q) * q
 
+    def _clamp_limits(self, kv_sink: int | None,
+                      kv_window: int | None) -> tuple[int, int]:
+        """Clamp a per-request sink/window override to the pool config
+        (tighten-only — you can never widen past what every slot's HBM
+        budget was sized for) and round UP to whole blocks: retirement
+        frees whole blocks, and a window shorter than one block would
+        retire the block the next write needs. submit() and
+        import_kv_blocks() funnel here so a wire-carried override lands
+        on the importer exactly as the exporter clamped it."""
+        bs = self.block_size
+        win = self.kv_window_tokens if kv_window is None else kv_window
+        win = min(self.kv_window_tokens, self._round_up(win, bs))
+        sink = self.kv_sink_tokens if kv_sink is None else kv_sink
+        sink = min(self.kv_sink_tokens, self._round_up(sink, bs))
+        return int(sink), int(win)
+
     def _paged_admissions(self) -> int:
         """Advance the admission pipeline: while nothing is decoding,
         push the current prefill to completion and keep admitting (an
@@ -1544,8 +1617,18 @@ class ServingEngine:
         cannot be covered."""
         fresh = self._alloc.alloc(n)
         if fresh is None and self._radix is not None:
+            # parked sessions must never deadlock a live admission:
+            # byte pressure outranks the session_hbm_max count, so
+            # demote LRU residents down the hierarchy (store / spill —
+            # lossless either way) until eviction covers the shortfall
+            while (self._sessions
+                   and self._radix.evictable_count()
+                   < n - self._alloc.free_count):
+                self._demote_session(next(iter(self._sessions)))
             short = n - self._alloc.free_count
-            if self._radix.evictable_count() >= short:
+            if short <= 0:
+                fresh = self._alloc.alloc(n)
+            elif self._radix.evictable_count() >= short:
                 self._radix.reclaim(short)
                 fresh = self._alloc.alloc(n)
         return fresh
@@ -1999,6 +2082,10 @@ class ServingEngine:
             stop_ids=tuple(req.stop_ids),
             leaves=self._gather_blocks(self._slot_blocks[slot][:nb]),
             kv_dtype=self.kv_dtype,
+            # the effective per-request window rides the wire (ISSUE 18
+            # bug fix): without it the importer would ATTEND positions
+            # the exporter's tightened mask had retired
+            kv_sink=req.kv_sink, kv_window=req.kv_window,
             # the ORIGIN submit + trace identity ride the handoff
             # (ISSUE 17): unix-epoch so two processes agree on it
             origin_t=(None if req.origin_submit_time is None
@@ -2070,6 +2157,14 @@ class ServingEngine:
             raise ValueError(
                 "payload pool leaves do not match this engine's pool "
                 "(different model or layer stacking)")
+        if payload.kv_window is not None or payload.kv_sink is not None:
+            if not (self.kv_window_tokens and self.per_slot_limits):
+                raise ValueError(
+                    "payload carries a per-request kv_window/kv_sink "
+                    "override but this engine has no per-slot mask "
+                    "leaves (kv_window_tokens == 0 or paged_attn="
+                    "'pallas') — importing it would ATTEND positions "
+                    "the exporter's tightened mask retired")
         if not self._free:
             return None
         nb = payload.num_blocks
@@ -2114,6 +2209,14 @@ class ServingEngine:
         self._temps[slot] = payload.sampling.temperature
         self._top_ks[slot] = payload.sampling.top_k
         self._top_ps[slot] = payload.sampling.top_p
+        if payload.kv_window is not None or payload.kv_sink is not None:
+            # re-apply the exporter's tightened mask (ISSUE 18 bug
+            # fix): re-clamp against THIS pool's config — tighten-only
+            # both ways — and stamp the slot's mask leaves so the
+            # resumed stream masks exactly what the exporter's would
+            req.kv_sink, req.kv_window = self._clamp_limits(
+                payload.kv_sink, payload.kv_window)
+            self._set_slot_limits(slot, req.kv_sink, req.kv_window)
         if self.spec_k:
             # the imported blocks carry no DRAFT K/V, so heads-mode
             # proposals start cold here — acceptance suffers, tokens
@@ -2189,6 +2292,265 @@ class ServingEngine:
         st["kv_imported_blocks"] += nb - m
         st["kv_stream_bytes"] += payload.nbytes
         return nb - m
+
+    # ------------------------------------------------------------------
+    # persistent sessions (ISSUE 18): the HBM-resident tier + the
+    # detach/attach/seed surface the tiered store and router ride
+
+    def detach_request(self, handle: Request) -> KVBlockPayload:
+        """Export a LIVE mid-stream request's KV + continuation
+        contract as a KVBlockPayload and retire it locally with
+        finish_reason "detached" — the suspend half of a fleet-wide
+        session reattach. ``import_kv_blocks`` on ANY replica (this
+        one included) continues the stream bitwise as if it had never
+        been interrupted: the payload is exactly the disagg handoff
+        wire format, including the partial tail block PAST the radix
+        full-block boundary, the per-request kv_sink/kv_window
+        override and the trace identity. Parked prefill_only requests
+        delegate to export_kv_blocks."""
+        if not self.paged:
+            raise ValueError("detach_request requires the paged engine")
+        if self.spec_k:
+            raise ValueError(
+                "detach_request does not compose with spec_k > 0 "
+                "(the draft pool is not on the KV stream)")
+        if handle.id in self._prefilled:
+            return self.export_kv_blocks(handle)
+        slot = handle.slot
+        if slot is None or self._active.get(slot) is not handle:
+            raise ValueError(
+                f"request {handle.id} is not resident (queued, "
+                f"mid-prefill or already finished) — nothing to "
+                f"detach")
+        true_len = int(self._lengths[slot])
+        nb = -(-true_len // self.block_size)
+        payload = KVBlockPayload(
+            prompt=handle.prompt.copy(),
+            generated=list(handle.new_tokens),
+            true_len=true_len, block_size=self.block_size,
+            max_new_tokens=handle.max_new_tokens,
+            sampling=handle.sampling,
+            stop_ids=tuple(handle.stop_ids),
+            leaves=self._gather_blocks(self._slot_blocks[slot][:nb]),
+            kv_dtype=self.kv_dtype,
+            kv_sink=handle.kv_sink, kv_window=handle.kv_window,
+            origin_t=(None if handle.origin_submit_time is None
+                      else _trace_to_unix(handle.origin_submit_time)),
+            trace=(None if handle.trace is None
+                   else handle.trace.to_wire()))
+        del self._active[slot]
+        self._release_slot(slot)
+        handle.slot = None
+        handle.done = True
+        handle.finish_reason = "detached"
+        handle.finish_time = time.perf_counter()
+        st = self._stats
+        st["kv_exports"] += 1
+        st["kv_exported_blocks"] += nb
+        st["kv_stream_bytes"] += payload.nbytes
+        st["session_detaches"] += 1
+        if self.telemetry is not None:
+            self.telemetry.request(handle)
+        return payload
+
+    def seed_session_blocks(self, payload: KVBlockPayload, *,
+                            remote: bool = False) -> int:
+        """Adopt a stored session's FULL KV blocks into the pool +
+        radix so the reattaching turn's prefill rides them as a prefix
+        hit — bitwise-equal to re-prefilling them, minus the compute.
+        The partial tail block (true_len past the full-block boundary)
+        is NOT published — radix granularity is full blocks — so the
+        reattaching turn re-prefills at most block_size - 1 positions.
+        Best-effort by design: returns the number of prefix TOKENS now
+        backed, 0 on ANY mismatch (wire version, dtype, geometry,
+        window-retired payloads whose gathered trash rows must never
+        enter the prefix cache) or pool pressure — a declined seed
+        just means a plain re-prefill, lossless by construction."""
+        if (not self.paged or self._radix is None or self.spec_k
+                or payload.block_size != self.block_size
+                or payload.kv_dtype != self.kv_dtype
+                or payload.wire_version != KV_WIRE_VERSION
+                or payload.kv_window is not None
+                or payload.kv_sink is not None
+                or [n for n, _ in payload.leaves]
+                != self._pool_leaf_names()):
+            return 0
+        if not payload.generated or payload.true_len != (
+                payload.prompt.size + len(payload.generated) - 1):
+            return 0
+        bs = self.block_size
+        nbf = payload.true_len // bs
+        if not nbf:
+            return 0
+        tokens = np.concatenate(
+            [payload.prompt,
+             np.asarray(payload.generated[:-1], np.int32)])
+        st = self._stats
+        matched = self._radix.match(tokens[:nbf * bs])
+        m = len(matched)
+        if m < nbf:
+            fresh = self._alloc_blocks(nbf - m)
+            if fresh is None:
+                return 0
+            suffix = [np.take(a, np.arange(m, nbf),
+                              axis=_pool_block_axis(n, a.ndim))
+                      for n, a in payload.leaves]
+            self._scatter_blocks(fresh, suffix)
+            self._radix.insert(tokens[:nbf * bs], matched + fresh,
+                               remote=remote)
+            for b in fresh:  # the radix reference is the sole owner
+                self._alloc.decref(b)
+            st["kv_imported_blocks"] += nbf - m
+            st["kv_stream_bytes"] += payload.nbytes
+        st["session_attaches"] += 1
+        st["session_seed_tokens"] += nbf * bs
+        return nbf * bs
+
+    def take_demoted_sessions(self
+                              ) -> list[tuple[str, str, KVBlockPayload]]:
+        """Drain the spill queue: ``(session_id, tenant, payload)``
+        triples the HBM-budget sweep demoted while NO session_store is
+        attached — what a router/worker absorbs into the fleet store
+        (the subprocess wire's pull side)."""
+        if not self.paged:
+            return []
+        out, self._session_spill = self._session_spill, []
+        return out
+
+    def _reattach_session(self, sid: str) -> None:
+        """Pull a session's KV as close to HBM as it can get BEFORE
+        the request queues, so its prefill rides the radix prefix hit:
+        a resident session publishes its full blocks into the radix; a
+        store-tier session seeds its payload back into the pool. A
+        miss at every tier is SILENT — the prefill behind it is the
+        lossless fallback, the router's fallback counter the loud
+        part."""
+        if sid in self._sessions:
+            self._adopt_resident_session(sid)
+            self._stats["session_attaches"] += 1
+        elif self.session_store is not None:
+            got = self.session_store.get(sid)
+            if got is not None:
+                self.seed_session_blocks(got[0])
+
+    def _adopt_resident_session(self, sid: str) -> None:
+        """Move a parked session from the resident tier into the radix
+        prefix cache: its contiguous non-retired full blocks publish
+        under the conversation tokens (the reattaching prefill matches
+        them like any shared prefix), then the session's own references
+        drop — the radix is the sole owner, and the partial tail block
+        frees (its positions re-prefill with the new turn)."""
+        rec = self._sessions.pop(sid)
+        req = rec["req"]
+        bs = self.block_size
+        nbf = rec["true_len"] // bs
+        blocks = rec["blocks"]
+        # a windowed session's retired blocks are zero sentinels — the
+        # radix may only ever see the contiguous LIVE prefix (a trash
+        # block published as cached KV would serve garbage)
+        k = 0
+        while k < nbf and blocks[k]:
+            k += 1
+        if k and self._radix is not None:
+            tokens = np.concatenate(
+                [req.prompt, np.asarray(req.new_tokens, np.int32)])
+            self._radix.insert(tokens[:k * bs], blocks[:k])
+        for b in blocks:
+            if b:
+                self._alloc.decref(b)
+
+    def _park_session(self, req: Request) -> None:
+        """Park a finishing session stream's KV in the HBM-resident
+        tier: ownership of the slot's blocks transfers to the session
+        record (the list empties, so the _release_slot that follows
+        frees everything EXCEPT them), and the LRU budget sweep demotes
+        the eldest resident down the hierarchy."""
+        slot = req.slot
+        true_len = int(self._lengths[slot])
+        if true_len < 1:
+            return
+        nb = -(-true_len // self.block_size)
+        blocks = list(self._slot_blocks[slot][:nb])
+        # blocks past true_len (grown for the write the retirement
+        # preempted) stay with the slot and free in _release_slot
+        self._slot_blocks[slot] = self._slot_blocks[slot][nb:]
+        old = self._sessions.pop(req.session_id, None)
+        if old is not None:  # superseded turn: the newer KV wins
+            for b in old["blocks"]:
+                if b:
+                    self._alloc.decref(b)
+        self._sessions[req.session_id] = dict(
+            req=req, blocks=blocks, true_len=true_len,
+            tenant=req.tenant)
+        self._stats["session_detaches"] += 1
+        self._enforce_session_budget()
+
+    def _enforce_session_budget(self) -> None:
+        while len(self._sessions) > self.session_hbm_max:
+            self._demote_session(next(iter(self._sessions)))
+
+    def _demote_session(self, sid: str) -> None:
+        """Demote one resident session down the hierarchy: gather its
+        blocks into a KVBlockPayload (the PR 11 wire format — the same
+        bytes a disagg handoff ships) bound for the attached
+        session_store's host-DRAM/disk tiers, or the bounded spill
+        queue a router drains over the subprocess wire. The HBM blocks
+        free either way."""
+        rec = self._sessions.pop(sid)
+        payload = self._session_to_payload(rec)
+        st = self._stats
+        st["session_demotes"] += 1
+        if self.session_store is not None:
+            self.session_store.put(sid, payload, tenant=rec["tenant"])
+        elif len(self._session_spill) >= 64:
+            # bounded: an unattended engine must not hoard host copies
+            self._session_spill.pop(0)
+            self._session_spill.append((sid, rec["tenant"], payload))
+            st["session_dropped"] += 1
+        else:
+            self._session_spill.append((sid, rec["tenant"], payload))
+
+    def _demote_all_sessions(self) -> None:
+        for sid in list(self._sessions):
+            self._demote_session(sid)
+
+    def _session_to_payload(self, rec: dict) -> KVBlockPayload:
+        """Gather a resident session record into the wire payload and
+        free its HBM blocks — the record must already be popped."""
+        req = rec["req"]
+        nb = -(-rec["true_len"] // self.block_size)
+        payload = KVBlockPayload(
+            prompt=req.prompt.copy(), generated=list(req.new_tokens),
+            true_len=rec["true_len"], block_size=self.block_size,
+            max_new_tokens=req.max_new_tokens, sampling=req.sampling,
+            stop_ids=tuple(req.stop_ids),
+            leaves=self._gather_blocks(rec["blocks"][:nb]),
+            kv_dtype=self.kv_dtype,
+            kv_sink=req.kv_sink, kv_window=req.kv_window)
+        for b in rec["blocks"]:
+            if b:
+                self._alloc.decref(b)
+        self._stats["kv_stream_bytes"] += payload.nbytes
+        return payload
+
+    def export_session(self, session_id: str) -> KVBlockPayload | None:
+        """Pop a RESIDENT parked session and hand it over as a
+        KVBlockPayload (blocks gathered, then freed) — the fleet
+        reattach's cross-replica pull: when a reattaching turn lands
+        on a different replica than the session's HBM home, the router
+        pulls the payload here and seeds it there. None when this
+        engine holds nothing for the id (the caller falls through to
+        the store tiers, then to re-prefill)."""
+        if not self.paged:
+            return None
+        rec = self._sessions.pop(session_id, None)
+        if rec is None:
+            return None
+        payload = self._session_to_payload(rec)
+        st = self._stats
+        st["kv_exports"] += 1
+        st["kv_exported_blocks"] += payload.num_blocks
+        return payload
 
     def warmup_kv_stream(self) -> None:
         """Compile the KV stream's two programs with one empty-blocks
@@ -2317,6 +2679,11 @@ class ServingEngine:
         handler; close() also drains). Returns the drained requests."""
         self._draining = False
         out: list[Request] = []
+        if self.paged and self._sessions:
+            # resident sessions demote down the hierarchy on shutdown
+            # (store or spill queue) — restart-survival for the warm
+            # tier, and close()'s leak assertion sees a clean pool
+            self._demote_all_sessions()
         if self.paged and self._prefilling is not None:
             pf, self._prefilling = self._prefilling, None
             self._release_slot(pf["slot"])
@@ -2563,6 +2930,14 @@ class ServingEngine:
         if req.slot is not None:  # deadline-expired in queue: no slot yet
             del self._active[req.slot]
             if self.paged:
+                if (req.session_id is not None
+                        and reason in ("stop", "length")):
+                    # a CLEANLY finishing session turn parks its KV in
+                    # the resident tier (ownership transfers off the
+                    # slot before the release below); sheds —
+                    # deadline, drain — free normally, the store's
+                    # older copy (if any) stays the session's truth
+                    self._park_session(req)
                 # EVERY retirement path funnels here: the slot's blocks
                 # go back to the pool (or live on only through the radix
                 # cache's own reference) — close() asserts none leak
@@ -2646,6 +3021,12 @@ class ServingEngine:
             out["admitted_tokens"] = self._stats["admitted_tokens"]
             if self._radix is not None:
                 out["prefix_frontier"] = self._radix.frontier()
+            # the session signals (ISSUE 18): how many sessions park
+            # in this replica's HBM tier, and WHICH — the router's
+            # FleetSessionIndex steers reattaching requests by this
+            # frontier exactly like prefix steering
+            out["sessions_resident"] = len(self._sessions)
+            out["session_frontier"] = list(self._sessions)[-64:]
         return out
 
     def check_params_finite(self) -> bool:
@@ -2780,7 +3161,16 @@ class ServingEngine:
                            kv_imported_blocks=0, kv_stream_bytes=0,
                            # speculative counters (stay 0 when spec off)
                            draft_tokens=0, accepted_tokens=0,
-                           target_forwards=0)
+                           target_forwards=0,
+                           # persistent-session counters (ISSUE 18):
+                           # detaches = turns parked/exported, attaches
+                           # = reattach KV hits (any tier), seed_tokens
+                           # = prefix tokens seeded from stored
+                           # payloads, demotes = HBM -> store/spill
+                           # evictions, dropped = spill-queue overflow
+                           session_detaches=0, session_attaches=0,
+                           session_seed_tokens=0, session_demotes=0,
+                           session_dropped=0)
 
     @property
     def queue_depth(self) -> int:
@@ -2870,6 +3260,23 @@ class ServingEngine:
             out["kv_exported_blocks"] = st["kv_exported_blocks"]
             out["kv_imported_blocks"] = st["kv_imported_blocks"]
             out["kv_stream_bytes"] = st["kv_stream_bytes"]
+            # persistent-session telemetry (ISSUE 18): the HBM tier's
+            # current residency and the lifecycle counters — the
+            # host-DRAM/disk tiers report from SessionStore.stats()
+            per_block = self.kv_hbm_bytes // self.num_blocks
+            out["sessions"] = dict(
+                resident=len(self._sessions),
+                resident_blocks=sum(
+                    len(r["blocks"])
+                    for r in self._sessions.values()),
+                resident_bytes=per_block * sum(
+                    len(r["blocks"])
+                    for r in self._sessions.values()),
+                detaches=st["session_detaches"],
+                attaches=st["session_attaches"],
+                seed_tokens=st["session_seed_tokens"],
+                demotes=st["session_demotes"],
+                dropped=st["session_dropped"])
             if self._radix is not None:
                 out["prefix_cache"] = self._radix.stats()
         if self.spec_k:

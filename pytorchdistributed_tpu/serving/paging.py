@@ -439,3 +439,52 @@ class FleetPrefixIndex:
 
     def replicas(self) -> list[int]:
         return sorted(self._frontiers)
+
+
+class FleetSessionIndex:
+    """FleetPrefixIndex's sibling for persistent sessions (ISSUE 18):
+    the router-owned map of which replica holds a session RESIDENT in
+    its HBM tier (blocks parked after stream close). Replicas publish
+    their resident session ids through ``health()`` snapshots
+    (``session_frontier``); the dispatcher steers a reattaching
+    ``submit(session_id=...)`` to the owner — a zero-copy radix
+    re-seed there — before falling back to the router's host-DRAM/disk
+    ``SessionStore`` tiers. Pure host state; refreshed (not
+    accumulated) per snapshot, so demotions, evictions and replica
+    deaths age out naturally."""
+
+    def __init__(self):
+        self._resident: dict[int, set[str]] = {}
+
+    def update(self, replica: int, session_ids) -> None:
+        """Replace ``replica``'s published resident set."""
+        self._resident[replica] = set(session_ids or ())
+
+    def add(self, replica: int, session_id: str) -> None:
+        """Optimistic bookkeeping right after a steered reattach or a
+        finished session stream — the owner answers for the session
+        before the next health snapshot confirms it."""
+        self._resident.setdefault(replica, set()).add(session_id)
+
+    def discard(self, session_id: str) -> None:
+        """Forget a session fleet-wide (demoted into the store, or
+        dropped)."""
+        for have in self._resident.values():
+            have.discard(session_id)
+
+    def remove(self, replica: int) -> None:
+        self._resident.pop(replica, None)
+
+    def owner(self, session_id: str, eligible=None) -> int | None:
+        """The replica holding ``session_id`` resident, or None. Ties
+        (stale overlapping snapshots) break toward the lowest index —
+        deterministic steering, exactly like best_match."""
+        for rep in sorted(self._resident):
+            if eligible is not None and rep not in eligible:
+                continue
+            if session_id in self._resident[rep]:
+                return rep
+        return None
+
+    def sessions(self, replica: int) -> set[str]:
+        return set(self._resident.get(replica, ()))

@@ -155,6 +155,19 @@ def _restore_draft_params(path, step=None):
     return jax.tree.map(jnp.asarray, params), ckpt_step
 
 
+def _drain_demoted_sessions(engine) -> list:
+    """Wire-encode whatever sessions the engine's HBM budget (or its
+    drain) demoted since the last sweep — they ride step/drain replies
+    to the router, which persists them into the store tiers."""
+    demoted = engine.take_demoted_sessions()
+    if not demoted:
+        return []
+    from pytorchdistributed_tpu.serving.engine import kv_payload_to_wire
+
+    return [[sid, tenant, kv_payload_to_wire(payload)]
+            for sid, tenant, payload in demoted]
+
+
 def _build_engine(spec: dict):
     from pytorchdistributed_tpu.models import (
         GPT2,
@@ -311,6 +324,8 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                     prefill_only=bool(op.get("prefill_only")),
                     kv_window=op.get("kv_window"),
                     kv_sink=op.get("kv_sink"),
+                    session_id=op.get("session_id"),
+                    tenant=op.get("tenant", "default"),
                     trace=op.get("trace"),
                     origin_t=op.get("origin_t"))
             except ValueError as e:
@@ -345,10 +360,15 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
             sweep_finished()
             if heartbeat is not None:
                 heartbeat.beat()  # after the engine's host sync
-            reply(ok=True, delivered=list(delivered),
-                  finished=list(finished), health=engine.health(),
-                  parked=[r.router_rid for r in engine.parked_requests
-                          if hasattr(r, "router_rid")])
+            step_reply = dict(
+                ok=True, delivered=list(delivered),
+                finished=list(finished), health=engine.health(),
+                parked=[r.router_rid for r in engine.parked_requests
+                        if hasattr(r, "router_rid")])
+            demoted = _drain_demoted_sessions(engine)
+            if demoted:
+                step_reply["demoted_sessions"] = demoted
+            reply(**step_reply)
             # clear IN PLACE: on_token/sweep_finished close over these
             delivered.clear()
             finished.clear()
@@ -435,10 +455,36 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                   draft_swaps=engine.draft_swaps)
         elif kind == "probe":
             reply(finite=engine.check_params_finite())
+        elif kind == "export_session":
+            # persistent sessions (ISSUE 18): hand a RESIDENT parked
+            # session's KV over the wire (cross-replica reattach pull)
+            from pytorchdistributed_tpu.serving.engine import (
+                kv_payload_to_wire,
+            )
+
+            payload = engine.export_session(op["session_id"])
+            if payload is None:
+                reply(ok=False, error="no such resident session")
+            else:
+                reply(ok=True, payload=kv_payload_to_wire(payload))
+        elif kind == "seed_session":
+            from pytorchdistributed_tpu.serving.engine import (
+                kv_payload_from_wire,
+            )
+
+            seeded = engine.seed_session_blocks(
+                kv_payload_from_wire(op["payload"]), remote=True)
+            reply(ok=True, seeded=int(seeded))
         elif kind == "drain":
             engine.drain()
             sweep_finished()
-            reply(ok=True, finished=list(finished))
+            drain_reply = dict(ok=True, finished=list(finished))
+            demoted = _drain_demoted_sessions(engine)
+            if demoted:
+                # the drain demoted every resident session — the router
+                # persists them (clean drain) or discards (quarantine)
+                drain_reply["demoted_sessions"] = demoted
+            reply(**drain_reply)
             finished.clear()
         elif kind == "close":
             shutdown()  # drain + close exactly once (finally is a noop)

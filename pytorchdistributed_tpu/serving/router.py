@@ -85,6 +85,7 @@ from pytorchdistributed_tpu.serving.engine import (
 )
 from pytorchdistributed_tpu.serving.paging import (
     FleetPrefixIndex,
+    FleetSessionIndex,
     block_hashes,
 )
 from pytorchdistributed_tpu.serving.telemetry import RouterTelemetry
@@ -155,7 +156,8 @@ class RouterRequest:
                  deadline_s: float | None = None,
                  tenant: str | None = None, priority: int = 0,
                  kv_window: int | None = None,
-                 kv_sink: int | None = None):
+                 kv_sink: int | None = None,
+                 session_id: str | None = None):
         self.id = next(RouterRequest._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = max_new_tokens
@@ -171,6 +173,9 @@ class RouterRequest:
         # clamps to its pool config and may REFUSE incompatible pools)
         self.kv_window = kv_window
         self.kv_sink = kv_sink
+        # persistent session (ISSUE 18): the multi-turn identity this
+        # stream's KV survives under after the stream closes
+        self.session_id = session_id
         self.tokens: list[int] = []          # the delivered stream
         self.done = False
         self.finish_reason: str | None = None
@@ -250,6 +255,7 @@ class InProcessReplica:
             deadline_s=deadline_s, generated=generated, on_token=on_token,
             prefill_only=prefill_only,
             kv_window=rr.kv_window, kv_sink=rr.kv_sink,
+            session_id=rr.session_id, tenant=rr.tenant,
             trace=rr.trace,
             origin_t=(None if rr.submit_time is None
                       else _trace_to_unix(rr.submit_time)))
@@ -276,6 +282,22 @@ class InProcessReplica:
 
     def import_prefix(self, payload) -> int:
         return self.engine.import_prefix_blocks(payload)
+
+    # -- persistent sessions (ISSUE 18) -------------------------------
+
+    def export_session(self, session_id: str):
+        """Pull a RESIDENT parked session off this replica (cross-
+        replica reattach: the turn landed elsewhere)."""
+        return self.engine.export_session(session_id)
+
+    def seed_session(self, payload) -> int:
+        """Seed a session payload into this replica's prefix cache so
+        the reattaching submit rides an ordinary prefix hit. Returns
+        tokens seeded (0 = declined → re-prefill)."""
+        return self.engine.seed_session_blocks(payload, remote=True)
+
+    def take_demoted_sessions(self):
+        return self.engine.take_demoted_sessions()
 
     def step(self) -> None:
         if self._crash_next:
@@ -415,6 +437,9 @@ class SubprocessReplica:
                               "ttft_ema_s": None, "sick": False}
         self._pending_op: str | None = None
         self._probe_result: bool | None = None
+        # session payloads demoted by the worker, awaiting the router's
+        # store-persist sweep: [(sid, tenant, wire_payload), ...]
+        self._demoted: list = []
         full_env = dict(os.environ)
         if env:
             full_env.update(env)
@@ -535,6 +560,11 @@ class SubprocessReplica:
               "prefill_only": bool(prefill_only),
               "kv_window": rr.kv_window,
               "kv_sink": rr.kv_sink}
+        # session identity rides only when set, keeping the off-wire
+        # byte-identical to pre-session traffic
+        if rr.session_id is not None:
+            op["session_id"] = rr.session_id
+            op["tenant"] = rr.tenant
         # origin submit + trace identity (ISSUE 17): unix-epoch and a
         # plain dict so the worker needs no shared clock or objects;
         # trace keys ride only when tracing minted a context, so the
@@ -643,6 +673,33 @@ class SubprocessReplica:
         resp = self.wait_response(max(self.hang_grace_s, 30.0))
         return int(resp.get("adopted", 0)) if resp.get("ok") else 0
 
+    # -- persistent sessions (ISSUE 18) -------------------------------
+    # Like handoffs, session pulls/seeds are synchronous roundtrips:
+    # rare relative to ticks, and the payload must not interleave with
+    # step traffic on the one-in-flight wire.
+
+    def export_session(self, session_id: str):
+        self._drain_wire()
+        self._send({"op": "export_session", "session_id": session_id})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        if resp.get("ok") is not True or not resp.get("payload"):
+            return None
+        return kv_payload_from_wire(resp["payload"])
+
+    def seed_session(self, payload) -> int:
+        self._drain_wire()
+        self._send({"op": "seed_session",
+                    "payload": kv_payload_to_wire(payload)})
+        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        return int(resp.get("seeded", 0)) if resp.get("ok") else 0
+
+    def take_demoted_sessions(self):
+        """Drain session payloads the worker demoted (reported in step
+        replies) — the router persists them into the store tiers."""
+        out, self._demoted = self._demoted, []
+        return [(sid, tenant, kv_payload_from_wire(wire))
+                for sid, tenant, wire in out]
+
     def _drain_wire(self, timeout: float | None = None) -> None:
         """Consume the pending response (if any) before sending a new
         op — the one-in-flight invariant. Only submit/drain/close use
@@ -680,6 +737,8 @@ class SubprocessReplica:
             m = self._mirrors.get(rid)
             if m is not None:
                 m.parked = True
+        for item in resp.get("demoted_sessions", []):
+            self._demoted.append(tuple(item))
         for rid, reason in resp.get("finished", []):
             m = self._mirrors.pop(rid, None)
             if m is not None:
@@ -864,7 +923,8 @@ class ReplicaRouter:
                  telemetry_dir=None, sample_every: int = 1,
                  tenants=None, admission=None,
                  preempt_every: int = 8, seed: int = 0,
-                 trace="auto", slo_ttft_s: float | None = None):
+                 trace="auto", slo_ttft_s: float | None = None,
+                 session_store=None):
         self.warmup_lens = tuple(warmup_lens) if warmup_lens else None
         # distributed request tracing (ISSUE 17): OFF unless asked —
         # trace=True (needs telemetry_dir for the files), a
@@ -1000,6 +1060,12 @@ class ReplicaRouter:
         # the fleet-wide prefix index (ISSUE 12): every replica's
         # published radix frontier, refreshed from health snapshots
         self._prefix_index = FleetPrefixIndex()
+        # the fleet-wide session index (ISSUE 18): session → owning
+        # replica, refreshed from the same health snapshots; with a
+        # SessionStore attached, demoted sessions flow into the host-
+        # DRAM/disk tiers and reattaching turns are pulled back up
+        self._session_index = FleetSessionIndex()
+        self.session_store = session_store
         self.max_queue = max_queue
         self.max_retries = max_retries
         self.retry_policy = retry_policy
@@ -1081,7 +1147,8 @@ class ReplicaRouter:
                on_token=None, deadline_s: float | None = None,
                tenant: str | None = None, priority: int = 0,
                kv_window: int | None = None,
-               kv_sink: int | None = None) -> RouterRequest:
+               kv_sink: int | None = None,
+               session_id: str | None = None) -> RouterRequest:
         """Queue one request with the router (dispatch to a replica
         happens inside step(), against fresh health snapshots). Returns
         the durable RouterRequest handle — ``handle.tokens`` is the
@@ -1112,12 +1179,21 @@ class ReplicaRouter:
             raise ValueError(f"kv_sink must be >= 0, got {kv_sink}")
         if priority < 0:
             raise ValueError(f"priority must be >= 0, got {priority}")
+        if session_id is not None:
+            from pytorchdistributed_tpu.serving.sessions import (
+                session_id_ok,
+            )
+
+            if not session_id_ok(session_id):
+                raise ValueError(
+                    f"malformed session_id {session_id!r} (want "
+                    f"[A-Za-z0-9][A-Za-z0-9._:-]*, <= 128 chars)")
         rr = RouterRequest(prompt, max_new_tokens,
                            sampling or SamplingParams(),
                            stop_ids_tuple(stop_ids), on_token,
                            deadline_s=deadline_s, tenant=tenant,
                            priority=priority, kv_window=kv_window,
-                           kv_sink=kv_sink)
+                           kv_sink=kv_sink, session_id=session_id)
         rr.submit_time = time.perf_counter()
         if self.trace is not None:
             # mint the request's fleet-wide trace identity here — the
@@ -1211,6 +1287,22 @@ class ReplicaRouter:
                 r.step()
             except ReplicaCrashed:
                 self._declare_dead(r, "crashed")
+        # 4a. persist replica-demoted sessions into the store tiers
+        # (ISSUE 18) — the engine's HBM budget pushed them out; the
+        # store's DRAM/disk tiers keep them reattachable
+        if self.session_store is not None:
+            for r in self._replicas:
+                if self._status[r.index] not in (HEALTHY, DRAINING):
+                    continue
+                try:
+                    demoted = r.take_demoted_sessions()
+                except (ReplicaCrashed, TimeoutError):
+                    self._declare_dead(r, "crashed")
+                    continue
+                for sid, tenant, payload in demoted:
+                    self.session_store.put(sid, payload, tenant=tenant)
+                    self._session_index.discard(sid)
+                    self._stats["session_demotes"] += 1
         # 4b. sweep parked prefill-role admissions onto decode-capable
         # replicas over the KV stream (ISSUE 12)
         self._handoffs()
@@ -1282,6 +1374,8 @@ class ReplicaRouter:
             self._health[i] = h
             if "prefix_frontier" in h:
                 self._prefix_index.update(i, h["prefix_frontier"])
+            if "session_frontier" in h:
+                self._session_index.update(i, h["session_frontier"])
             if not h.get("alive", True):
                 self._declare_dead(r, "crashed")
                 continue
@@ -1357,6 +1451,9 @@ class ReplicaRouter:
             return
         self._status[r.index] = DEAD
         self._prefix_index.remove(r.index)
+        # resident sessions died with the replica: forget the ownership
+        # claims so reattaches fall through to the store tiers
+        self._session_index.remove(r.index)
         # a respawn reboots from the SPEC's draft (if any) — the swapped
         # identity died with the process
         self._draft_info.pop(r.index, None)
@@ -1500,13 +1597,19 @@ class ReplicaRouter:
         probing for recovery."""
         self._status[r.index] = QUARANTINED
         self._prefix_index.remove(r.index)
+        # KV written under non-finite params is poison: drop ownership
+        # AND discard any pending demoted-session payloads instead of
+        # persisting them — a reattach must re-prefill, never resume
+        # from a sick replica's blocks
+        self._session_index.remove(r.index)
         self._clean_probes[r.index] = 0
         self._stats["quarantines"] += 1
         self._event("quarantine", replica=r.index)
         self._failover(r, "sick")
         try:
             r.quarantine_reset()
-        except ReplicaCrashed:
+            r.take_demoted_sessions()
+        except (ReplicaCrashed, TimeoutError):
             self._declare_dead(r, "crashed")
 
     def _rejoin(self, r) -> None:
@@ -1640,10 +1743,30 @@ class ReplicaRouter:
                     resident=len(self._assigned[i]))
         return i
 
+    def _persist_replica_sessions(self, r) -> None:
+        """Demote-and-persist a replica's resident sessions before it
+        goes away (close / scale-down tombstone): drain the engine —
+        which pushes every parked session into its demote queue — then
+        sweep the queue into the store tiers. Restart survival for the
+        warm tier; best-effort (a wedged replica just loses its HBM
+        tier and reattaches re-prefill)."""
+        if self.session_store is None:
+            return
+        try:
+            r.drain()
+            demoted = r.take_demoted_sessions()
+        except (ReplicaCrashed, TimeoutError):
+            return
+        for sid, tenant, payload in demoted:
+            self.session_store.put(sid, payload, tenant=tenant)
+            self._session_index.discard(sid)
+            self._stats["session_demotes"] += 1
+
     def _finalize_removals(self) -> None:
         for i, s in enumerate(self._status):
             if s != DRAINING or self._assigned[i]:
                 continue
+            self._persist_replica_sessions(self._replicas[i])
             try:
                 self._replicas[i].close()
             except Exception:  # noqa: BLE001 — the tombstone wins
@@ -1862,6 +1985,64 @@ class ReplicaRouter:
             self._event("prefix_ship", request=rr.id, owner=owner,
                         target=best.index, blocks=adopted, depth=depth)
 
+    def _prepare_session(self, rr: RouterRequest, r) -> None:
+        """Reattach plumbing before placement (ISSUE 18): make the
+        session's KV resident on the TARGET replica so the submit rides
+        an ordinary prefix hit. Tier order — already home (the index
+        steered us to the owner: the engine adopts internally), pull
+        from the owning replica over the wire, then the store's
+        host-DRAM/disk tiers. Every decline falls through; when the
+        session was KNOWN somewhere and still ends up re-prefilling,
+        that's the LOUD lossless fallback (session_fallback event)."""
+        sid = rr.session_id
+        eligible = [i for i, s in enumerate(self._status)
+                    if s in (HEALTHY, DRAINING)]
+        owner = self._session_index.owner(sid, eligible)
+        if owner == r.index:
+            self._stats["session_reattach"]["hbm"] += 1
+            self._event("session_reattach", session=sid, tier="hbm",
+                        replica=r.index)
+            return
+        known = owner is not None or (
+            self.session_store is not None
+            and self.session_store.peek_tier(sid) is not None)
+        payload, tier = None, "hbm"
+        if owner is not None:
+            try:
+                payload = self._replicas[owner].export_session(sid)
+            except (ReplicaCrashed, TimeoutError):
+                payload = None  # health machinery will notice
+            # the export popped it (or the owner never had it): either
+            # way the claim is stale now
+            self._session_index.discard(sid)
+        if payload is None and self.session_store is not None:
+            got = self.session_store.get(sid)
+            if got is not None:
+                payload, tier = got
+        if payload is not None:
+            try:
+                seeded = r.seed_session(payload)
+            except (ReplicaCrashed, TimeoutError):
+                seeded = 0
+            if seeded > 0:
+                self._stats["session_reattach"][tier] += 1
+                if tier == "hbm":
+                    # crossed the wire replica→replica
+                    self._stats["session_ships"] += 1
+                    self._stats["kv_stream_bytes"] += payload.nbytes
+                self._event("session_reattach", session=sid, tier=tier,
+                            replica=r.index, owner=owner, tokens=seeded)
+                return
+            if tier == "hbm" and self.session_store is not None:
+                # seed declined but the payload was already popped off
+                # the owner — park it in the store rather than lose it
+                self.session_store.put(sid, payload, tenant=rr.tenant)
+        if known:
+            self._stats["session_fallbacks"] += 1
+            self._event("session_fallback", session=sid,
+                        replica=r.index, owner=owner,
+                        tier=(tier if payload is not None else None))
+
     def _dispatch(self) -> int:
         healthy = [r for r in self._replicas
                    if self._status[r.index] == HEALTHY]
@@ -1901,6 +2082,12 @@ class ReplicaRouter:
             # replica that already holds the blocks skips whole prefill
             # chunks, which is worth more than any load delta
             chain = self._prefix_chain(rr)
+            # session affinity dominates even prefix depth: the owner
+            # replica holds the WHOLE conversation's blocks resident —
+            # landing there costs zero wire bytes and zero re-prefill
+            sowner = (self._session_index.owner(
+                rr.session_id, [r.index for r in cands])
+                if rr.session_id is not None else None)
             best, best_key = None, None
             for r in cands:
                 h = self._health[r.index]
@@ -1910,7 +2097,8 @@ class ReplicaRouter:
                     continue
                 depth = (self._prefix_index.match_depth(r.index, chain)
                          if chain else 0)
-                key = (-depth, self._replica_score(h, mean_ttft),
+                key = (0 if sowner == r.index else 1,
+                       -depth, self._replica_score(h, mean_ttft),
                        self._placements[r.index], r.index)
                 if best_key is None or key < best_key:
                     best, best_key = r, key
@@ -1954,6 +2142,12 @@ class ReplicaRouter:
             and any(self._status[x.index] == HEALTHY
                     and self._roles[x.index] in (ROLE_DECODE, ROLE_BOTH)
                     for x in self._replicas))
+        # reattach prep (ISSUE 18): fresh turns only — a failover
+        # redispatch resumes from its delivered tokens, and a non-paged
+        # target (no block_size in health) has no tiers to seed
+        if (rr.session_id is not None and not rr.tokens
+                and self._health[r.index].get("block_size")):
+            self._prepare_session(rr, r)
         try:
             handle = r.submit(rr, generated=rr.tokens or None,
                               deadline_s=remaining, on_token=cb,
@@ -1982,6 +2176,10 @@ class ReplicaRouter:
         rr._handle = handle
         rr._replica = r.index
         rr.replicas.append(r.index)
+        if rr.session_id is not None:
+            # optimistic ownership: the stream parks HERE at finish —
+            # steer the next turn before the health refresh catches up
+            self._session_index.add(r.index, rr.session_id)
         self._placements[r.index] += 1
         self._assigned[r.index][rr.id] = rr
         # keep this tick's snapshot honest for the next pick
@@ -2438,6 +2636,13 @@ class ReplicaRouter:
         invariant; subprocess workers get the SIGTERM→kill_group
         escalation — no orphans), stamp the telemetry summary."""
         self.drain()
+        if self.session_store is not None:
+            for r in self._replicas:
+                if self._status[r.index] in (HEALTHY, DRAINING):
+                    self._persist_replica_sessions(r)
+            # the store flushes its DRAM tier to disk (restart
+            # survival) but stays open — the caller owns its lifetime
+            self.session_store.flush()
         subs = [r for r in self._replicas
                 if isinstance(r, SubprocessReplica)
                 and self._status[r.index] != REMOVED]
@@ -2491,6 +2696,10 @@ class ReplicaRouter:
                            respawns=0, respawn_failures=0,
                            handoffs=0, handoff_failures=0,
                            prefix_ships=0, kv_stream_bytes=0,
+                           session_reattach={"hbm": 0, "dram": 0,
+                                             "disk": 0},
+                           session_fallbacks=0, session_ships=0,
+                           session_demotes=0,
                            scale_ups=0, scale_downs=0,
                            draft_swaps=0,
                            preemptions=0, preempted_requeues=0,
@@ -2609,6 +2818,20 @@ class ReplicaRouter:
             out["draft"] = {
                 i: dict(info)
                 for i, info in sorted(self._draft_info.items())}
+        if (self.session_store is not None
+                or any(st["session_reattach"].values())
+                or st["session_fallbacks"] or st["session_demotes"]):
+            sess = {
+                "reattach": dict(st["session_reattach"]),
+                "fallbacks": st["session_fallbacks"],
+                "ships": st["session_ships"],
+                "demotes": st["session_demotes"],
+                "resident": sum(h.get("sessions_resident", 0)
+                                for h in self._health),
+            }
+            if self.session_store is not None:
+                sess["store"] = self.session_store.stats()
+            out["sessions"] = sess
         if st["tenants"]:
             adm = (self._admission.tenant_stats()
                    if self._admission is not None else {})

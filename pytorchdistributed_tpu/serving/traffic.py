@@ -34,11 +34,15 @@ import zlib
 import numpy as np
 
 __all__ = [
+    "Conversation",
+    "ConversationTurn",
     "FakeClock",
     "TenantTraffic",
     "TrafficRequest",
+    "make_conversations",
     "make_trace",
     "replay",
+    "replay_conversations",
 ]
 
 
@@ -168,6 +172,178 @@ def make_trace(*, seed: int, duration_s: float, base_qps: float,
             max_new_tokens=_lognormal_len(rng, new_mean, new_sigma, 1,
                                           new_cap)))
     return out
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ConversationTurn:
+    """One user turn of a multi-turn conversation: only the NEW user
+    tokens — the replay driver concatenates the session's full history
+    (earlier prompts + model replies) in front, which is exactly what
+    a stateful chat client resubmits. ``think_gap_s`` is the seeded
+    think time between the previous turn's last token and this turn's
+    arrival (0.0 on the opening turn — the open time lives on the
+    Conversation)."""
+
+    user_tokens: np.ndarray   # int32 [len] — this turn's NEW tokens
+    max_new_tokens: int
+    think_gap_s: float
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Conversation:
+    """One generated multi-turn session: opens at ``open_at_s``, then
+    each turn follows the previous turn's completion by its think gap.
+    ``session_id`` is stable across turns — the persistent-session
+    reattach key."""
+
+    session_id: str
+    tenant: str
+    priority: int
+    open_at_s: float
+    turns: tuple[ConversationTurn, ...]
+
+
+def make_conversations(*, seed: int, duration_s: float,
+                       session_rate: float,
+                       tenants: tuple[TenantTraffic, ...] | None = None,
+                       turns_mean: float = 3.0, turns_sigma: float = 0.5,
+                       turns_cap: int = 8,
+                       think_mean_s: float = 1.0,
+                       vocab_size: int = 64,
+                       turn_mean: float = 6.0, turn_sigma: float = 0.5,
+                       turn_cap: int = 16,
+                       new_mean: float = 6.0, new_sigma: float = 0.5,
+                       new_cap: int = 12) -> list[Conversation]:
+    """Generate a deterministic multi-turn conversation mix, sorted by
+    ``open_at_s`` (ISSUE 18's traffic shape).
+
+    Session OPENS are Poisson at ``session_rate``; each session draws
+    a lognormal turn count (clipped to [1, turns_cap]), exponential
+    think-time gaps with mean ``think_mean_s`` between turns, and
+    heavy-tailed per-turn user/new token lengths. Tenants come from the
+    same ``TenantTraffic`` mix as :func:`make_trace` — a tenant with
+    ``prefix_len``/``prefix_frac`` opens its sessions with the shared
+    tenant prompt (the system-prompt shape prefix caching feeds on).
+    ``session_id`` is ``f"{tenant}-s{k}"`` with k the global open order
+    — same seed, same ids, same tokens."""
+    if session_rate <= 0 or duration_s <= 0:
+        raise ValueError("session_rate and duration_s must be > 0")
+    tenants = tenants or (TenantTraffic("default"),)
+    total_share = sum(t.share for t in tenants)
+    if total_share <= 0:
+        raise ValueError("tenant shares must sum > 0")
+    cum = np.cumsum([t.share / total_share for t in tenants])
+    prefixes = {
+        t.name: np.random.default_rng(
+            (seed, zlib.crc32(t.name.encode()))
+        ).integers(1, vocab_size, (t.prefix_len,)).astype(np.int32)
+        for t in tenants if t.prefix_len > 0
+    }
+    rng = np.random.default_rng((seed, 0x5e55))
+    out: list[Conversation] = []
+    t = 0.0
+    k = 0
+    while True:
+        t += float(rng.exponential(1.0 / session_rate))
+        if t >= duration_s:
+            break
+        ti = int(np.searchsorted(cum, rng.random(), side="right"))
+        ten = tenants[min(ti, len(tenants) - 1)]
+        n_turns = _lognormal_len(rng, turns_mean, turns_sigma, 1,
+                                 turns_cap)
+        turns = []
+        for j in range(n_turns):
+            ulen = _lognormal_len(rng, turn_mean, turn_sigma, 1, turn_cap)
+            toks = rng.integers(1, vocab_size, (ulen,)).astype(np.int32)
+            if j == 0 and ten.prefix_len \
+                    and rng.random() < ten.prefix_frac:
+                toks = np.concatenate(
+                    [prefixes[ten.name], toks])[:ten.prefix_len + ulen]
+            turns.append(ConversationTurn(
+                user_tokens=toks,
+                max_new_tokens=_lognormal_len(rng, new_mean, new_sigma,
+                                              1, new_cap),
+                think_gap_s=(0.0 if j == 0 else round(
+                    float(rng.exponential(think_mean_s)), 6))))
+        out.append(Conversation(
+            session_id=f"{ten.name}-s{k}", tenant=ten.name,
+            priority=ten.priority, open_at_s=round(t, 6),
+            turns=tuple(turns)))
+        k += 1
+    return out
+
+
+def replay_conversations(router, convs, *,
+                         clock: FakeClock | None = None,
+                         tick_s: float = 0.02, autoscaler=None,
+                         on_turn=None, max_seq_len: int | None = None,
+                         submit_kwargs: dict | None = None,
+                         max_ticks: int = 500_000) -> dict[str, list]:
+    """Drive ``router`` through a conversation mix against a fake
+    clock. A session's turn t submits only after turn t-1 finished AND
+    its think gap has elapsed — the stream-close/reattach rhythm the
+    session tiers live on. Each submit carries ``session_id=`` and the
+    FULL history (prior prompts + delivered replies) as its prompt,
+    exactly like a stateful chat client; turns that would overflow
+    ``max_seq_len`` end their conversation early. Returns
+    {session_id: [turn handles...]} in submit order."""
+    clock = clock or FakeClock()
+    kwargs = submit_kwargs or {}
+    # per-conversation cursor: next turn index, earliest release time,
+    # accumulated token history, the in-flight handle (if any)
+    state = [{"c": c, "turn": 0, "ready_at": c.open_at_s,
+              "history": np.zeros(0, np.int32), "inflight": None}
+             for c in sorted(convs, key=lambda c: c.open_at_s)]
+    out: dict[str, list] = {c.session_id: [] for c in convs}
+    for ticks in range(max_ticks):
+        now = clock.now()
+        live = False
+        for s in state:
+            c = s["c"]
+            if s["inflight"] is not None:
+                rr = s["inflight"]
+                if not rr.done:
+                    live = True
+                    continue
+                toks = np.asarray(rr.tokens, np.int32)
+                s["history"] = np.concatenate(
+                    [rr.prompt, toks]) if rr.finish_reason in (
+                        "stop", "length") else s["history"]
+                s["inflight"] = None
+                s["turn"] += 1
+                if (s["turn"] < len(c.turns)
+                        and rr.finish_reason in ("stop", "length")):
+                    s["ready_at"] = (now
+                                     + c.turns[s["turn"]].think_gap_s)
+                else:
+                    s["turn"] = len(c.turns)  # shed/failed: close early
+            if s["turn"] >= len(c.turns) or s["ready_at"] > now:
+                live = live or s["turn"] < len(c.turns)
+                continue
+            turn = c.turns[s["turn"]]
+            prompt = np.concatenate([s["history"], turn.user_tokens])
+            if (max_seq_len is not None
+                    and prompt.size + turn.max_new_tokens > max_seq_len):
+                s["turn"] = len(c.turns)  # context exhausted
+                continue
+            rr = router.submit(prompt,
+                               max_new_tokens=turn.max_new_tokens,
+                               tenant=c.tenant, priority=c.priority,
+                               session_id=c.session_id, **kwargs)
+            out[c.session_id].append(rr)
+            if on_turn is not None:
+                on_turn(c, s["turn"], rr, clock)
+            s["inflight"] = rr
+            live = True
+        router.step()
+        if autoscaler is not None:
+            autoscaler.step()
+        if not live and all(s["turn"] >= len(s["c"].turns)
+                            for s in state):
+            return out
+        clock.advance(tick_s)
+    raise RuntimeError(
+        f"conversation replay did not drain within {max_ticks} ticks")
 
 
 def replay(router, trace, *, clock: FakeClock | None = None,

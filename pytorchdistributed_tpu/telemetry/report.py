@@ -434,6 +434,37 @@ def _router_section(run_dir: str) -> list[str]:
                     f"  {name:>10}  {t.get('submitted', 0):>9}  "
                     f"{t.get('completed', 0):>9}  {t.get('shed', 0):>5}  "
                     f"{p99_s:>10}  {wt_s:>6}  {ov_s:>8}")
+        sess = (summary or {}).get("sessions") or {}
+        if sess:
+            # the persistent-session tier table (ISSUE 18): where
+            # reattaching turns found their KV — resident HBM, the
+            # store's host-DRAM tier, or disk — vs the loud lossless
+            # re-prefill fallbacks
+            store = sess.get("store") or {}
+            rea = sess.get("reattach") or {}
+            lines.append(
+                f"  {'tier':>6}  {'sessions':>8}  {'bytes':>12}  "
+                f"{'reattach':>8}")
+            tiers = (
+                ("hbm", sess.get("resident", 0), None),
+                ("dram", store.get("dram_sessions"),
+                 store.get("dram_bytes")),
+                ("disk", store.get("disk_sessions"),
+                 store.get("disk_bytes")),
+            )
+            for tier, n, nbytes in tiers:
+                n_s = "-" if n is None else f"{n:g}"
+                b_s = ("-" if nbytes is None
+                       else f"{nbytes / 1e6:.2f} MB")
+                lines.append(f"  {tier:>6}  {n_s:>8}  {b_s:>12}  "
+                             f"{rea.get(tier, 0):>8}")
+            extras = (f"  session_fallbacks {sess.get('fallbacks', 0)}"
+                      f"  ships {sess.get('ships', 0)}"
+                      f"  demotes {sess.get('demotes', 0)}")
+            if store.get("quarantined") or store.get("torn"):
+                extras += (f"  quarantined {store.get('quarantined', 0)}"
+                           f"  torn {store.get('torn', 0)}")
+            lines.append(extras)
         # the scaling timeline (ISSUE 15): autoscale_* rows are the
         # control loop's decisions (stamped with the breach that
         # justified them), scale_* the router acting on them (or an

@@ -25,7 +25,6 @@ Offline: ``python -m pytorchdistributed_tpu.training.checkpoint verify
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import pathlib
@@ -43,8 +42,17 @@ from pytorchdistributed_tpu.telemetry.events import (
     EventLog,
 )
 
-MANIFEST_NAME = "ptd_manifest.json"
-QUARANTINE_DIR = "quarantine"
+# the integrity discipline itself (hashing, atomic manifest publish,
+# verification verdicts, quarantine moves) is shared with the serving
+# layer's persistent-session disk tier (ISSUE 18) via utils/manifest —
+# the names below stay importable from here for compatibility
+from pytorchdistributed_tpu.utils.manifest import (
+    MANIFEST_NAME,
+    QUARANTINE_DIR,
+    hash_file as _hash_file_impl,
+    verify_dir_manifest,
+    write_dir_manifest,
+)
 
 # Files the manifest must NOT cover: the manifest itself, and orbax's
 # step-metadata sidecar — orbax appends commit_timestamp_nsecs to it in
@@ -73,11 +81,7 @@ class StepVerdict:
 
 
 def _hash_file(path: pathlib.Path) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    return _hash_file_impl(path)
 
 
 class CheckpointManager:
@@ -170,20 +174,9 @@ class CheckpointManager:
     def write_manifest(self, step: int) -> pathlib.Path:
         """Per-file size + SHA-256 manifest for a COMMITTED step,
         written atomically (tmp + rename) beside the data it covers."""
-        sdir = self.step_dir(step)
-        files = {}
-        for p in sorted(sdir.rglob("*")):
-            if not p.is_file() or p.name in _MANIFEST_EXCLUDE:
-                continue
-            rel = str(p.relative_to(sdir))
-            files[rel] = {"size": p.stat().st_size, "sha256": _hash_file(p)}
-        manifest = {"step": step, "time": round(time.time(), 3),
-                    "files": files}
-        path = self._manifest_path(step)
-        tmp = path.with_name(path.name + ".tmp")
-        tmp.write_text(json.dumps(manifest, indent=0, sort_keys=True))
-        os.replace(tmp, path)
-        return path
+        return write_dir_manifest(self.step_dir(step),
+                                  exclude=_MANIFEST_EXCLUDE,
+                                  extra={"step": step})
 
     def verify_step(self, step: int) -> StepVerdict:
         """Check a committed step against its manifest. A step with NO
@@ -397,22 +390,8 @@ def verify_directory(directory: str | pathlib.Path) -> list[StepVerdict]:
 def _verify_step_dir(step: int, sdir: pathlib.Path) -> StepVerdict:
     """Manifest check against one step directory (shared by
     CheckpointManager.verify_step's logic and the standalone CLI)."""
-    mpath = sdir / MANIFEST_NAME
-    if not mpath.exists():
-        return StepVerdict(step, True, False, "no manifest (unverified)")
-    try:
-        entries = dict(json.loads(mpath.read_text())["files"])
-    except (OSError, ValueError, KeyError, TypeError) as e:
-        return StepVerdict(step, False, False, f"unreadable manifest ({e})")
-    for rel, meta in entries.items():
-        p = sdir / rel
-        if not p.is_file():
-            return StepVerdict(step, False, True, f"missing file {rel}")
-        if p.stat().st_size != meta.get("size"):
-            return StepVerdict(step, False, True, f"size mismatch {rel}")
-        if _hash_file(p) != meta.get("sha256"):
-            return StepVerdict(step, False, True, f"checksum mismatch {rel}")
-    return StepVerdict(step, True, True, f"{len(entries)} files ok")
+    ok, verified, detail = verify_dir_manifest(sdir)
+    return StepVerdict(step, ok, verified, detail)
 
 
 def main(argv=None) -> int:

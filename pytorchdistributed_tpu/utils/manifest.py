@@ -1,0 +1,122 @@
+"""Directory integrity manifests (ISSUE 18): the per-file
+size + SHA-256 discipline CheckpointManager introduced (PR 4/10),
+factored out so every durable tier in the repo — training checkpoints,
+persistent KV sessions — shares ONE contract:
+
+  * data files are written first, the manifest LAST and atomically
+    (tmp + os.replace), so the manifest's presence is the publish: a
+    directory without one is torn-by-definition and must be treated as
+    a miss, never as truth;
+  * verification checks sizes before hashes (cheap reject first) and
+    returns positive-evidence verdicts — "no manifest" is unverified,
+    a mismatch against an existing manifest is corruption;
+  * corrupt directories are QUARANTINED (moved aside as post-mortem
+    evidence, never deleted), race-tolerantly: on a shared filesystem
+    every reader walks the same fallback chain, so losing the
+    os.replace race to a sibling is success.
+
+No jax, no orbax — host-side stdlib only, importable from the serving
+layer without dragging the checkpoint stack in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import time
+
+MANIFEST_NAME = "ptd_manifest.json"
+QUARANTINE_DIR = "quarantine"
+
+
+def hash_file(path: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_dir_manifest(dirpath: str | pathlib.Path, *,
+                       exclude: frozenset | set = frozenset(),
+                       extra: dict | None = None) -> pathlib.Path:
+    """Per-file size + SHA-256 manifest over every file under
+    ``dirpath`` (recursive), written atomically beside the data it
+    covers. ``exclude`` names (basenames) are skipped — the manifest
+    itself always is. ``extra`` keys are merged into the top-level
+    manifest dict (e.g. a step number, a session's metadata)."""
+    dirpath = pathlib.Path(dirpath)
+    files = {}
+    for p in sorted(dirpath.rglob("*")):
+        if (not p.is_file() or p.name == MANIFEST_NAME
+                or p.name in exclude or p.name.endswith(".tmp")):
+            continue
+        rel = str(p.relative_to(dirpath))
+        files[rel] = {"size": p.stat().st_size, "sha256": hash_file(p)}
+    manifest = dict(extra or {})
+    manifest["time"] = round(time.time(), 3)
+    manifest["files"] = files
+    path = dirpath / MANIFEST_NAME
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent=0, sort_keys=True))
+    os.replace(tmp, path)
+    return path
+
+
+def verify_dir_manifest(dirpath: str | pathlib.Path
+                        ) -> tuple[bool, bool, str]:
+    """Check a directory against its manifest. Returns
+    ``(ok, verified, detail)``: a directory with NO manifest passes
+    unverified (``(True, False, ...)`` — legacy data, or a writer that
+    died after the data landed but before publish); a manifest that
+    exists and mismatches is positive evidence of corruption
+    (``(False, True, ...)``)."""
+    dirpath = pathlib.Path(dirpath)
+    mpath = dirpath / MANIFEST_NAME
+    if not mpath.exists():
+        return True, False, "no manifest (unverified)"
+    try:
+        entries = dict(json.loads(mpath.read_text())["files"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return False, False, f"unreadable manifest ({e})"
+    for rel, meta in entries.items():
+        p = dirpath / rel
+        if not p.is_file():
+            return False, True, f"missing file {rel}"
+        if p.stat().st_size != meta.get("size"):
+            return False, True, f"size mismatch {rel}"
+        if hash_file(p) != meta.get("sha256"):
+            return False, True, f"checksum mismatch {rel}"
+    return True, True, f"{len(entries)} files ok"
+
+
+def read_manifest(dirpath: str | pathlib.Path) -> dict | None:
+    """The parsed manifest dict, or None when absent/unreadable —
+    metadata-only reads (``ls``-style listings) that must not trust an
+    unpublished directory."""
+    mpath = pathlib.Path(dirpath) / MANIFEST_NAME
+    try:
+        return json.loads(mpath.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def quarantine_dir(dirpath: str | pathlib.Path, *,
+                   root: str | pathlib.Path | None = None
+                   ) -> pathlib.Path:
+    """Move a corrupt directory into ``<root>/quarantine/`` (evidence,
+    not garbage; ``root`` defaults to the directory's parent).
+    Race-tolerant: a sibling process moving it first is success."""
+    dirpath = pathlib.Path(dirpath)
+    qdir = pathlib.Path(root or dirpath.parent) / QUARANTINE_DIR
+    qdir.mkdir(parents=True, exist_ok=True)
+    dest = qdir / dirpath.name
+    if dest.exists():  # a prior incarnation quarantined this name too
+        dest = qdir / f"{dirpath.name}.{int(time.time() * 1e3)}"
+    try:
+        os.replace(dirpath, dest)
+    except FileNotFoundError:
+        dest = qdir / dirpath.name  # a sibling moved it first
+    return dest

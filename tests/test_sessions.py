@@ -1,0 +1,794 @@
+"""Persistent sessions + the tiered KV memory hierarchy (ISSUE 18).
+
+Correctness bar: a multi-turn session whose KV parked in HBM, demoted
+to the host-DRAM tier, spilled to disk, or reattached on a DIFFERENT
+replica must produce streams BITWISE-identical (greedy AND seeded) to
+the same turn sequence served by one uninterrupted engine — and a
+corrupted/torn/version-skewed stored session must NEVER serve wrong
+KV: it quarantines (or misses) and the turn re-prefills losslessly.
+On top: SessionStore tier/LRU/tenant-cap units, the manifest restart
+survival + offline ls/verify/gc CLI, FleetSessionIndex units, the
+engine/router validation walls, the kv_window wire carry (satellite
+4), the conversation traffic generator + replay driver, and the
+zero-recompile guarantee across park/adopt/demote/reattach.
+
+Engine geometry mirrors tests/test_router.py (gpt2 "test", 2 layers,
+max_seq_len 64, slots 3, bucket 16, paged block 8) so the compiled
+programs are shared across the suite's jit cache.
+"""
+
+import contextlib
+import dataclasses
+import functools
+import io
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.inference import generate
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import (
+    FleetSessionIndex,
+    KVBlockPayload,
+    ReplicaRouter,
+    SamplingParams,
+    ServingEngine,
+    SessionStore,
+    kv_payload_from_wire,
+    kv_payload_to_wire,
+    make_conversations,
+    replay_conversations,
+    session_id_ok,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.admission import TenantConfig
+from pytorchdistributed_tpu.serving.engine import (
+    paged_decode_tick,
+    paged_prefill_chunk,
+)
+from pytorchdistributed_tpu.serving.sessions import main as sessions_cli
+from pytorchdistributed_tpu.serving.traffic import TenantTraffic
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    dm = GPT2(dataclasses.replace(CFG, decode=True))
+    return model, params, dm
+
+
+def _ref(prompt, n):
+    _, params, dm = _setup()
+    return np.asarray(generate(dm, params, jnp.asarray(prompt)[None],
+                               max_new_tokens=n))[0]
+
+
+def _engine(**kw):
+    model, params, _ = _setup()
+    ek = dict(num_slots=3, prefill_bucket=16, block_size=8)
+    ek.update(kw)
+    engine = ServingEngine(model, params, **ek)
+    engine.warmup(prompt_lens=(16, 32))
+    engine.warmup_kv_stream()
+    return engine
+
+
+def _router(n, *, store=None, **kw):
+    model, params, _ = _setup()
+    ek = dict(num_slots=3, prefill_bucket=16, block_size=8,
+              session_hbm_max=2)
+    ek.update(kw.pop("engine_kwargs", {}))
+    router = ReplicaRouter(
+        model, params, replicas=n, engine_kwargs=ek,
+        warmup_lens=(16, 32), session_store=store, **kw)
+    router.warmup()
+    return router
+
+
+def _run(e, prompt, n, **kw):
+    h = e.submit(prompt, max_new_tokens=n, **kw)
+    while not h.done:
+        e.step()
+    return h
+
+
+def _router_run(router, rrs, max_steps=5000):
+    rrs = rrs if isinstance(rrs, list) else [rrs]
+    for _ in range(max_steps):
+        router.step()
+        if all(r.done for r in rrs):
+            return
+    raise AssertionError(
+        f"streams not done: {[r.finish_reason for r in rrs]}")
+
+
+def _mk_payload(n=16, bs=8, **kw):
+    """A synthetic payload for store-tier tests — the store treats the
+    leaves as opaque arrays, so numpy stand-ins exercise every tier."""
+    fields = dict(
+        prompt=np.arange(n, dtype=np.int32), generated=[5],
+        true_len=n, block_size=bs, max_new_tokens=4,
+        sampling=SamplingParams(), stop_ids=(),
+        leaves=[("h0/cached_key",
+                 np.ones((2, n // bs, bs, 4), np.float32))])
+    fields.update(kw)
+    return KVBlockPayload(**fields)
+
+
+# ----------------------------------------------------------------------
+# host units (no jax work)
+
+
+def test_session_id_validation():
+    assert session_id_ok("a")
+    assert session_id_ok("tenant-1.conv:42_b")
+    assert session_id_ok("A" * 128)
+    assert not session_id_ok("")
+    assert not session_id_ok("-leading-dash")
+    assert not session_id_ok(".hidden")
+    assert not session_id_ok("has space")
+    assert not session_id_ok("sl/ash")
+    assert not session_id_ok("A" * 129)
+
+
+def test_fleet_session_index_units():
+    idx = FleetSessionIndex()
+    assert idx.owner("s1") is None
+    idx.update(0, ["s1", "s2"])
+    idx.update(1, ["s2", "s3"])
+    assert idx.owner("s1") == 0
+    assert idx.owner("s3") == 1
+    # ties break to the lowest index (deterministic steering)
+    assert idx.owner("s2") == 0
+    assert idx.owner("s2", eligible=[1]) == 1
+    assert idx.owner("s2", eligible=[]) is None
+    # optimistic add answers before the next snapshot confirms it
+    idx.add(1, "s4")
+    assert idx.owner("s4") == 1
+    # the next snapshot REPLACES — demotions/evictions age out
+    idx.update(1, ["s3"])
+    assert idx.owner("s4") is None
+    idx.discard("s2")
+    assert idx.owner("s2") is None
+    idx.remove(0)
+    assert idx.owner("s1") is None
+    assert idx.sessions(1) == {"s3"}
+
+
+def test_store_lru_demotion_and_tenant_caps(tmp_path):
+    # per-tenant session caps (the PR 15 admission vocabulary) evict
+    # that tenant's oldest sessions only
+    st = SessionStore(str(tmp_path / "caps"), dram_bytes=1 << 30,
+                      tenants={"small": TenantConfig(max_sessions=2)})
+    for i in range(4):
+        st.put(f"small-{i}", _mk_payload(), tenant="small")
+    st.put("other-0", _mk_payload(), tenant="other")
+    s = st.stats()
+    assert s["tenant_evicted"] == 2, s
+    assert st.get("small-0") is None and st.get("small-1") is None
+    assert st.get("small-2") is not None and st.get("other-0") is not None
+
+    # DRAM pressure demotes in LRU order: touch "a" so "b" spills first
+    st2 = SessionStore(str(tmp_path / "lru"),
+                       dram_bytes=5 * _mk_payload().nbytes // 2)
+    st2.put("a", _mk_payload())
+    assert st2.peek_tier("a") == "dram"
+    st2.put("b", _mk_payload())
+    st2.get("a")  # touch — "b" is now the LRU entry
+    st2.put("c", _mk_payload())
+    assert st2.peek_tier("b") == "disk", st2.stats()
+    assert st2.peek_tier("a") == "dram"
+    s2 = st2.stats()
+    assert s2["demotes"] >= 1 and s2["spilled_bytes"] > 0
+    # a disk hit PROMOTES back up the hierarchy
+    got = st2.get("b")
+    assert got is not None and got[1] == "disk"
+    assert st2.stats()["promotes"] >= 1
+    st.close()
+    st2.close()
+
+
+def test_store_restart_corruption_torn_and_version(tmp_path):
+    d = str(tmp_path / "store")
+    st = SessionStore(d, dram_bytes=1 << 30)
+    st.put("alice", _mk_payload())
+    st.put("bob", _mk_payload(n=24, bs=8))
+    st.flush()
+    st.close()
+
+    # restart survival: a fresh store over the same dir serves both
+    st2 = SessionStore(d, dram_bytes=1 << 30)
+    assert st2.peek_tier("alice") == "disk"
+    p, tier = st2.get("bob")
+    assert tier == "disk"
+    np.testing.assert_array_equal(p.prompt, np.arange(24, dtype=np.int32))
+    st2.close()
+
+    # corruption -> quarantine: a torn payload can only MISS, never
+    # serve wrong KV; the session dir moves under quarantine/
+    sdir = next(x for x in pathlib.Path(d).iterdir()
+                if x.is_dir() and x.name.startswith("alice"))
+    pj = sdir / "payload.json"
+    pj.write_text(pj.read_text()[:-20] + '"corrupted": true}')
+    st3 = SessionStore(d, dram_bytes=1 << 30)
+    assert st3.get("alice") is None
+    assert st3.stats()["quarantined"] == 1
+    assert (pathlib.Path(d) / "quarantine").exists()
+    st3.close()
+
+    # torn publish (manifest never landed) -> counted miss
+    tdir = pathlib.Path(d) / "torn-1"
+    tdir.mkdir()
+    (tdir / "payload.json").write_text("{}")
+    st4 = SessionStore(d, dram_bytes=1 << 30)
+    assert st4.get("torn-1") is None
+    st4.close()
+
+    # wire-version skew: intact but from another era -> loud decline,
+    # never parsed into an engine
+    st5 = SessionStore(d, dram_bytes=1 << 30, wire_version=999)
+    assert st5.get("bob") is None
+    assert st5.stats()["version_declines"] == 1
+    st5.close()
+
+
+def test_store_cli_ls_verify_gc(tmp_path):
+    d = str(tmp_path / "store")
+    st = SessionStore(d, dram_bytes=1 << 30)
+    for i in range(3):
+        st.put(f"s-{i}", _mk_payload())
+    st.flush()
+    st.close()
+
+    def run_cli(args):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = sessions_cli(args)
+        return rc, buf.getvalue()
+
+    rc, out = run_cli(["ls", d])
+    assert rc == 0 and all(f"s-{i}" in out for i in range(3))
+    rc, out = run_cli(["verify", d])
+    assert rc == 0
+
+    # verify --strict flags a corrupted session non-zero
+    sdir = next(x for x in pathlib.Path(d).iterdir()
+                if x.is_dir() and x.name.startswith("s-0"))
+    (sdir / "payload.json").write_text("not json")
+    rc, out = run_cli(["verify", d, "--strict"])
+    assert rc != 0
+
+    # gc: --dry-run touches nothing, then --max-age 0 reaps everything
+    rc, out = run_cli(["gc", d, "--max-age", "0", "--dry-run"])
+    assert rc == 0
+    assert any(pathlib.Path(d).glob("s-*")), "dry-run must not delete"
+    rc, out = run_cli(["gc", d, "--max-age", "0"])
+    assert rc == 0
+    st2 = SessionStore(d, dram_bytes=1 << 30)
+    assert all(st2.peek_tier(f"s-{i}") is None for i in range(3))
+    st2.close()
+
+
+def test_conversation_generator_determinism():
+    tenants = (TenantTraffic("acme", share=2.0, prefix_len=8,
+                             prefix_frac=1.0),
+               TenantTraffic("solo", share=1.0))
+    a = make_conversations(seed=7, duration_s=20.0, session_rate=0.5,
+                           tenants=tenants, vocab_size=CFG.vocab_size)
+    b = make_conversations(seed=7, duration_s=20.0, session_rate=0.5,
+                           tenants=tenants, vocab_size=CFG.vocab_size)
+    assert len(a) == len(b) > 0
+    for ca, cb in zip(a, b):
+        assert ca.session_id == cb.session_id and session_id_ok(
+            ca.session_id)
+        assert ca.open_at_s == cb.open_at_s
+        assert len(ca.turns) == len(cb.turns) >= 1
+        for ta, tb in zip(ca.turns, cb.turns):
+            np.testing.assert_array_equal(ta.user_tokens, tb.user_tokens)
+            assert ta.max_new_tokens == tb.max_new_tokens
+            assert ta.think_gap_s == tb.think_gap_s
+        # opening turns release immediately; later turns think first
+        assert ca.turns[0].think_gap_s == 0.0
+        assert all(t.think_gap_s > 0.0 for t in ca.turns[1:])
+    assert [c.open_at_s for c in a] == sorted(c.open_at_s for c in a)
+    # a prefix_frac=1.0 tenant opens every session with its shared
+    # system-prompt prefix (the shape prefix caching feeds on)
+    acme = [c for c in a if c.tenant == "acme"]
+    assert acme, "share 2/3 over 20 s at 0.5/s must open acme sessions"
+    first = acme[0].turns[0].user_tokens[:8]
+    for c in acme[1:]:
+        np.testing.assert_array_equal(c.turns[0].user_tokens[:8], first)
+    # a different seed moves the mix
+    c = make_conversations(seed=8, duration_s=20.0, session_rate=0.5,
+                           tenants=tenants, vocab_size=CFG.vocab_size)
+    assert [x.open_at_s for x in c] != [x.open_at_s for x in a]
+
+
+# ----------------------------------------------------------------------
+# engine tier: walls, park/adopt/demote, store reattach
+
+
+def test_engine_and_router_session_walls(tmp_path):
+    # dense-refusal wall: sessions need the paged pool
+    model, params, _ = _setup()
+    dense = ServingEngine(model, params, num_slots=2)
+    with pytest.raises(ValueError, match="paged engine"):
+        dense.submit(np.arange(4, dtype=np.int32), max_new_tokens=2,
+                     session_id="s-1")
+    dense.close()
+
+    e = _engine()
+    with pytest.raises(ValueError, match="malformed session_id"):
+        e.submit(np.arange(4, dtype=np.int32), max_new_tokens=2,
+                 session_id="-bad id")
+
+    # seed declines (return 0, loud fallback upstream) — never a crash
+    good = _mk_payload()
+    assert e.seed_session_blocks(
+        _mk_payload(block_size=16)) == 0          # geometry mismatch
+    assert e.seed_session_blocks(
+        dataclasses.replace(good, kv_window=16)) == 0   # windowed trash
+    assert e.seed_session_blocks(
+        dataclasses.replace(good, kv_dtype="int8")) == 0  # pool dtype
+    assert e.seed_session_blocks(
+        dataclasses.replace(good, wire_version=999)) == 0
+    e.close()
+
+
+def test_engine_sessions_park_adopt_store_bitwise(tmp_path):
+    """The engine-level session lifecycle, bitwise at every tier: turn
+    1 parks in HBM, turn 2 adopts the resident blocks through the
+    radix, a second session forces a demote into the store
+    (session_hbm_max=1), a FRESH engine sharing the store reattaches
+    from the DRAM tier, and finally a corrupted disk session
+    quarantines and re-prefills — every turn equal to generate()."""
+    store = SessionStore(str(tmp_path / "kv"), dram_bytes=1 << 20)
+    e = _engine(session_store=store, session_hbm_max=1)
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    prefill_c = paged_prefill_chunk._cache_size()
+    decode_c = paged_decode_tick._cache_size()
+
+    rng = np.random.default_rng(0)
+    p1 = rng.integers(0, CFG.vocab_size, 20).astype(np.int32)
+    h1 = _run(e, p1, 6, session_id="alice-1", tenant="alice")
+    np.testing.assert_array_equal(h1.new_tokens, _ref(p1, 6)[len(p1):])
+    assert e._stats["session_detaches"] == 1
+
+    # turn 2: full history + fresh user tokens rides the parked blocks
+    p2 = np.concatenate([p1, np.asarray(h1.new_tokens, np.int32),
+                         rng.integers(0, CFG.vocab_size, 5).astype(
+                             np.int32)])
+    h2 = _run(e, p2, 6, session_id="alice-1", tenant="alice")
+    np.testing.assert_array_equal(h2.new_tokens, _ref(p2, 6)[len(p2):])
+    sess = e.summary()["sessions"]
+    assert sess["attaches"] == 1
+    assert e._stats["prefix_hit_tokens"] > 0, "adoption must ride radix"
+
+    # a second parked session busts session_hbm_max=1 -> demote to DRAM
+    p3 = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    _run(e, p3, 5, session_id="bob-1", tenant="bob")
+    assert e.summary()["sessions"]["demotes"] == 1
+    assert store.peek_tier("alice-1") == "dram"
+
+    # fresh engine, same store: turn 3 reattaches from host DRAM
+    e.close()
+    e2 = _engine(session_store=store, session_hbm_max=2)
+    p4 = np.concatenate([p2, np.asarray(h2.new_tokens, np.int32)])
+    h4 = _run(e2, p4, 4, session_id="alice-1", tenant="alice")
+    np.testing.assert_array_equal(h4.new_tokens, _ref(p4, 4)[len(p4):])
+    st2 = e2.summary()["sessions"]
+    assert st2["attaches"] == 1 and st2["seed_tokens"] > 0
+    assert store.stats()["hits_dram"] >= 1
+    e2.close()
+
+    # corrupt the disk copy: the reattach must quarantine + re-prefill
+    store.flush()
+    store.close()
+    root = pathlib.Path(str(tmp_path / "kv"))
+    sdir = next(x for x in root.iterdir()
+                if x.is_dir() and x.name.startswith("alice-1"))
+    pj = sdir / "payload.json"
+    pj.write_text(pj.read_text()[:-20] + '"corrupted": true}')
+    store2 = SessionStore(str(tmp_path / "kv"), dram_bytes=1 << 20)
+    e3 = _engine(session_store=store2, session_hbm_max=2)
+    h5 = _run(e3, p4, 4, session_id="alice-1", tenant="alice")
+    np.testing.assert_array_equal(h5.new_tokens, _ref(p4, 4)[len(p4):])
+    assert store2.stats()["quarantined"] == 1
+    assert e3.summary()["sessions"]["seed_tokens"] == 0
+    e3.close()
+    store2.close()
+
+    # the whole lifecycle compiled NOTHING new after warmup
+    assert dict(serving_engine.TRACE_COUNTS) == traces0
+    assert paged_prefill_chunk._cache_size() == prefill_c
+    assert paged_decode_tick._cache_size() == decode_c
+
+
+def test_engine_sessions_seeded_and_int8_bitwise(tmp_path):
+    """Seeded sampling on an int8 pool: a session demoted through the
+    store must resume bitwise-equal to one uninterrupted int8 engine
+    serving the same turn sequence (generate() is the bf16 oracle, so
+    the uninterrupted engine is the int8 reference)."""
+    sp = SamplingParams(temperature=0.9, top_k=8, seed=7)
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(0, CFG.vocab_size, 14).astype(np.int32)
+
+    colo = _engine(kv_dtype="int8")
+    w1 = list(_run(colo, p1, 6, sampling=sp).new_tokens)
+    p2 = np.concatenate([p1, np.asarray(w1, np.int32),
+                         rng.integers(0, CFG.vocab_size, 4).astype(
+                             np.int32)])
+    w2 = list(_run(colo, p2, 6, sampling=sp).new_tokens)
+    colo.close()
+
+    store = SessionStore(str(tmp_path / "kv8"), dram_bytes=1 << 20)
+    a = _engine(kv_dtype="int8", session_store=store, session_hbm_max=1)
+    h1 = _run(a, p1, 6, session_id="conv-8", sampling=sp)
+    assert list(h1.new_tokens) == w1
+    # force the demote, then reattach on a FRESH int8 engine
+    _run(a, rng.integers(0, CFG.vocab_size, 10).astype(np.int32), 4,
+         session_id="filler", sampling=sp)
+    assert store.peek_tier("conv-8") == "dram"
+    a.close()
+    b = _engine(kv_dtype="int8", session_store=store, session_hbm_max=2)
+    h2 = _run(b, p2, 6, session_id="conv-8", sampling=sp)
+    assert list(h2.new_tokens) == w2
+    assert b.summary()["sessions"]["seed_tokens"] > 0
+    b.close()
+    store.close()
+
+
+def test_kv_window_override_rides_wire():
+    """Satellite 4 (carried bug): export_kv_blocks on a slot with a
+    per-request kv_window override used to DROP the tightened limit on
+    the wire — the importer then attended over window-retired trash.
+    The override must ride the payload both directions."""
+    rng = np.random.default_rng(1)
+    p = rng.integers(0, CFG.vocab_size, 20).astype(np.int32)
+    a = _engine(kv_window_tokens=32, kv_sink_tokens=8)
+    b = _engine(kv_window_tokens=32, kv_sink_tokens=8)
+    h = a.submit(p, max_new_tokens=8, prefill_only=True,
+                 kv_window=16, kv_sink=8)
+    while not h.parked:
+        a.step()
+    pay = a.export_kv_blocks(h)
+    assert pay.kv_window == 16 and pay.kv_sink == 8
+    wire = kv_payload_from_wire(kv_payload_to_wire(pay))
+    assert wire.kv_window == 16 and wire.kv_sink == 8
+    h2 = b.import_kv_blocks(wire)
+    assert h2.kv_window == 16 and h2.kv_sink == 8
+    assert b._slot_windows[h2.slot] == 16
+    assert b._slot_sinks[h2.slot] == 8
+    while not h2.done:
+        b.step()
+    # reference: the same overridden request served colocated
+    c = _engine(kv_window_tokens=32, kv_sink_tokens=8)
+    h3 = _run(c, p, 8, kv_window=16, kv_sink=8)
+    np.testing.assert_array_equal(h2.new_tokens, h3.new_tokens)
+    # a windowless engine must REFUSE the windowed payload loudly
+    d = _engine()
+    with pytest.raises(ValueError, match="kv_window"):
+        d.import_kv_blocks(wire)
+    for e in (a, b, c, d):
+        e.close()
+
+
+def test_replica_ship_export_seed_bitwise():
+    """The cross-replica reattach mechanics in isolation: the owner
+    engine pops + gathers the resident session (export_session), the
+    target seeds it into its radix (seed_session_blocks remote=True),
+    and the next turn on the TARGET stays bitwise with generate()."""
+    rng = np.random.default_rng(5)
+    p1 = rng.integers(0, CFG.vocab_size, 18).astype(np.int32)
+    a, b = _engine(), _engine()
+    h1 = _run(a, p1, 6, session_id="ship-1")
+    np.testing.assert_array_equal(h1.new_tokens, _ref(p1, 6)[len(p1):])
+
+    pay = a.export_session("ship-1")
+    # the final sampled token's KV is never written (it was the output,
+    # not an input), so the parked cache covers prompt + 5 of 6 tokens
+    assert pay is not None and pay.true_len == len(p1) + 5
+    assert a.export_session("ship-1") is None, "export pops the session"
+    assert a.summary()["sessions"]["resident"] == 0
+
+    wire = kv_payload_from_wire(kv_payload_to_wire(pay))
+    seeded = b.seed_session_blocks(wire, remote=True)
+    assert seeded > 0
+
+    p2 = np.concatenate([p1, np.asarray(h1.new_tokens, np.int32),
+                         rng.integers(0, CFG.vocab_size, 4).astype(
+                             np.int32)])
+    h2 = _run(b, p2, 6, session_id="ship-1")
+    np.testing.assert_array_equal(h2.new_tokens, _ref(p2, 6)[len(p2):])
+    assert b._stats["prefix_hit_tokens"] >= seeded
+    a.close()
+    b.close()
+
+
+def test_parked_sessions_never_deadlock_admission(tmp_path):
+    """Byte pressure outranks session_hbm_max: when parked sessions
+    pin enough of the block pool that a live admission cannot cover
+    its allocation, the engine demotes LRU residents down the
+    hierarchy instead of spinning forever on pool pressure — and the
+    demoted sessions land intact in the store."""
+    store = SessionStore(str(tmp_path / "kv"), dram_bytes=1 << 20)
+    # pool = num_slots * (max_seq_len / block) = 3 * 8 = 24 blocks;
+    # hbm_max=8 lets parked sessions squat nearly all of it
+    e = _engine(session_store=store, session_hbm_max=8)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, CFG.vocab_size, 40).astype(np.int32)
+               for _ in range(4)]
+    for i, p in enumerate(prompts):
+        _run(e, p, 4, session_id=f"squat-{i}")
+    assert len(e._sessions) >= 3, "pressure setup must park sessions"
+    # a big sessionless admission needs more blocks than remain free
+    big = rng.integers(0, CFG.vocab_size, 48).astype(np.int32)
+    h = _run(e, big, 4)
+    np.testing.assert_array_equal(h.new_tokens, _ref(big, 4)[len(big):])
+    assert e.summary()["sessions"]["demotes"] >= 1
+    # every demoted session is still resumable from the store tier
+    for i in range(4):
+        sid = f"squat-{i}"
+        assert (sid in e._sessions) or store.peek_tier(sid) is not None
+    e.close()
+    store.close()
+
+
+# ----------------------------------------------------------------------
+# router tier: steering, demote sweep, restart, fallback
+
+
+def test_router_sessions_all_tiers_bitwise(tmp_path):
+    """The fleet-wide flow across every tier: turn 2 steered to the
+    HBM owner, a seeded session demoted into host DRAM under filler
+    pressure and reattached, then a BRAND-NEW router + store over the
+    same directory resuming from disk — every resumed stream bitwise
+    with one uninterrupted engine, zero recompiles throughout."""
+    store = SessionStore(str(tmp_path / "fleet"), dram_bytes=1 << 20)
+    r = _router(2, store=store)
+    with pytest.raises(ValueError, match="malformed session_id"):
+        r.submit(np.arange(4, dtype=np.int32), max_new_tokens=2,
+                 session_id="bad/slash")
+    traces0 = dict(serving_engine.TRACE_COUNTS)
+    prefill_c = paged_prefill_chunk._cache_size()
+    decode_c = paged_decode_tick._cache_size()
+
+    rng = np.random.default_rng(0)
+    p1 = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    rr1 = r.submit(p1, max_new_tokens=8, session_id="conv-a",
+                   tenant="t0")
+    _router_run(r, rr1)
+    t1 = list(rr1.tokens)
+    home = rr1.replicas[-1]
+    r.step()  # the next health snapshot publishes the parked frontier
+    assert r._session_index.owner("conv-a") == home
+
+    ref = _engine()
+    assert list(_run(ref, p1, 8).new_tokens) == t1
+    p2 = np.concatenate([p1, np.asarray(t1, np.int32),
+                         rng.integers(0, CFG.vocab_size, 4).astype(
+                             np.int32)])
+    ref2 = list(_run(ref, p2, 8).new_tokens)
+
+    # turn 2: steered back to the owner, zero-copy HBM reattach
+    rr2 = r.submit(p2, max_new_tokens=8, session_id="conv-a",
+                   tenant="t0")
+    _router_run(r, rr2)
+    assert rr2.replicas[-1] == home
+    assert list(rr2.tokens) == ref2
+    assert r.summary()["sessions"]["reattach"]["hbm"] == 1
+
+    # seeded session under demote pressure: filler sessions bust
+    # session_hbm_max=2, the step sweep persists into the store
+    sp = SamplingParams(temperature=0.9, top_k=8, seed=7)
+    refs = _engine()
+    ps = rng.integers(0, CFG.vocab_size, 10).astype(np.int32)
+    s1 = list(_run(refs, ps, 6, sampling=sp).new_tokens)
+    psx = np.concatenate([ps, np.asarray(s1, np.int32),
+                          rng.integers(0, CFG.vocab_size, 4).astype(
+                              np.int32)])
+    s2 = list(_run(refs, psx, 6, sampling=sp).new_tokens)
+
+    rs1 = r.submit(ps, max_new_tokens=6, session_id="conv-b",
+                   tenant="t0", sampling=sp)
+    _router_run(r, rs1)
+    assert list(rs1.tokens) == s1
+    evs = [r.submit(
+        rng.integers(0, CFG.vocab_size, 14).astype(np.int32),
+        max_new_tokens=4, session_id=f"filler-{k}", tenant="t0")
+        for k in range(5)]
+    _router_run(r, evs)
+    for _ in range(5):
+        r.step()  # demote sweeps drain the workers into the store
+    assert r.summary()["sessions"]["demotes"] >= 1
+
+    rs2 = r.submit(psx, max_new_tokens=6, session_id="conv-b",
+                   tenant="t0", sampling=sp)
+    _router_run(r, rs2)
+    assert list(rs2.tokens) == s2
+    assert sum(r.summary()["sessions"]["reattach"].values()) >= 2
+
+    r.close()  # persists every resident session, flushes DRAM to disk
+
+    # restart survival: new router + new store over the same directory
+    full = np.concatenate([psx, np.asarray(s2, np.int32),
+                           rng.integers(0, CFG.vocab_size, 3).astype(
+                               np.int32)])
+    s3 = list(_run(refs, full, 5, sampling=sp).new_tokens)
+    ref.close()
+    refs.close()
+    store2 = SessionStore(str(tmp_path / "fleet"), dram_bytes=1 << 20)
+    r2 = _router(1, store=store2)
+    rs3 = r2.submit(full, max_new_tokens=5, session_id="conv-b",
+                    tenant="t0", sampling=sp)
+    _router_run(r2, rs3)
+    assert list(rs3.tokens) == s3
+    st2 = r2.summary()["sessions"]
+    assert st2["reattach"]["disk"] + st2["reattach"]["dram"] >= 1
+    assert st2["fallbacks"] == 0
+    r2.close()
+    store2.close()
+
+    # park/steer/demote/seed across two routers compiled nothing new
+    assert dict(serving_engine.TRACE_COUNTS) == traces0
+    assert paged_prefill_chunk._cache_size() == prefill_c
+    assert paged_decode_tick._cache_size() == decode_c
+
+
+def test_router_cross_replica_reattach_when_owner_drains(tmp_path):
+    """A reattach that CANNOT land on the owner (it is draining out of
+    the dispatch set) still resumes losslessly on another replica —
+    shipped from the owner's HBM or pulled from the store tier the
+    drain demoted it into; re-prefill stays the loud fallback."""
+    store = SessionStore(str(tmp_path / "drain"), dram_bytes=1 << 20)
+    r = _router(2, store=store)
+    rng = np.random.default_rng(9)
+    p1 = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    rr1 = r.submit(p1, max_new_tokens=6, session_id="conv-d")
+    _router_run(r, rr1)
+    home = rr1.replicas[-1]
+    r.step()
+    assert r._session_index.owner("conv-d") == home
+
+    ref = _engine()
+    assert list(_run(ref, p1, 6).new_tokens) == list(rr1.tokens)
+    p2 = np.concatenate([p1, np.asarray(rr1.tokens, np.int32),
+                         rng.integers(0, CFG.vocab_size, 4).astype(
+                             np.int32)])
+    ref2 = list(_run(ref, p2, 6).new_tokens)
+    ref.close()
+
+    r.remove_replica(home)  # graceful drain: out of dispatch, alive
+    rr2 = r.submit(p2, max_new_tokens=6, session_id="conv-d")
+    _router_run(r, rr2)
+    assert rr2.replicas[-1] != home
+    assert list(rr2.tokens) == ref2
+    st = r.summary()["sessions"]
+    assert sum(st["reattach"].values()) + st["fallbacks"] >= 1
+    r.close()
+    store.close()
+
+
+def test_conversation_replay_drives_reattaches(tmp_path):
+    """The satellite-1 traffic shape end to end: a seeded multi-turn
+    conversation mix replayed through a sessioned router — later turns
+    reattach (HBM or store tier) instead of re-prefilling, and every
+    multi-turn session's final turn is bitwise with one uninterrupted
+    engine serving its full history."""
+    convs = make_conversations(seed=11, duration_s=8.0,
+                               session_rate=0.6,
+                               vocab_size=CFG.vocab_size,
+                               turns_cap=3, turn_cap=8, new_cap=6,
+                               think_mean_s=0.2)
+    assert any(len(c.turns) > 1 for c in convs)
+    store = SessionStore(str(tmp_path / "conv"), dram_bytes=1 << 20)
+    r = _router(1, store=store)
+    out = replay_conversations(r, convs, tick_s=0.05,
+                               max_seq_len=CFG.max_seq_len)
+    multi = [c for c in convs if len(out[c.session_id]) > 1]
+    assert multi, "mix must produce at least one multi-turn replay"
+    st = r.summary()["sessions"]
+    assert sum(st["reattach"].values()) >= 1
+    # full-history replay of one multi-turn session, uninterrupted
+    c = multi[0]
+    handles = out[c.session_id]
+    ref = _engine()
+    hist = np.zeros(0, np.int32)
+    for i, rr in enumerate(handles):
+        assert rr.finish_reason in ("stop", "length")
+        prompt = np.concatenate([hist, c.turns[i].user_tokens])
+        np.testing.assert_array_equal(rr.prompt, prompt)
+        want = _run(ref, prompt, c.turns[i].max_new_tokens).new_tokens
+        np.testing.assert_array_equal(rr.tokens, want,
+                                      err_msg=f"turn {i}")
+        hist = np.concatenate([prompt, np.asarray(want, np.int32)])
+    ref.close()
+    r.close()
+    store.close()
+
+
+# ----------------------------------------------------------------------
+# subprocess wire (full-suite-only: spawns jax-importing workers)
+
+
+def test_subprocess_sessions_e2e(tmp_path):
+    """The multi-host shape: session turns over the line-JSON wire —
+    the reattach steers to the subprocess owner (frontier rides
+    health), export/seed ship a session between workers, the demote
+    sweep drains workers into the router's store on close, and a
+    restarted subprocess fleet resumes from disk — all bitwise."""
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 3, "prefill_bucket": 16,
+                       "block_size": 8, "session_hbm_max": 2}}
+    store = SessionStore(str(tmp_path / "wire"), dram_bytes=1 << 20)
+    router = ReplicaRouter(workers=[spec, spec], warmup_lens=(16, 32),
+                           session_store=store, faults=None)
+    try:
+        router.warmup()
+        rng = np.random.default_rng(17)
+        p1 = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+        rr1 = router.submit(p1, max_new_tokens=6, session_id="wire-a")
+        router.run_until_idle(max_steps=200000)
+        np.testing.assert_array_equal(rr1.tokens,
+                                      _ref(p1, 6)[p1.size:])
+        home = rr1.replicas[-1]
+        router.step()
+        assert router._session_index.owner("wire-a") == home
+
+        # turn 2 steers to the subprocess owner (HBM reattach)
+        p2 = np.concatenate([p1, np.asarray(rr1.tokens, np.int32),
+                             rng.integers(0, CFG.vocab_size, 4).astype(
+                                 np.int32)])
+        rr2 = router.submit(p2, max_new_tokens=6, session_id="wire-a")
+        router.run_until_idle(max_steps=200000)
+        assert rr2.replicas[-1] == home
+        np.testing.assert_array_equal(rr2.tokens,
+                                      _ref(p2, 6)[p2.size:])
+        assert router.summary()["sessions"]["reattach"]["hbm"] == 1
+
+        # explicit wire ship on a throwaway session: export pops it
+        # from the owner worker, seed lands it in the other's radix
+        pb = rng.integers(0, CFG.vocab_size, 10).astype(np.int32)
+        rrb = router.submit(pb, max_new_tokens=4, session_id="wire-b")
+        router.run_until_idle(max_steps=200000)
+        router.step()
+        bhome = rrb.replicas[-1]
+        pay = router._replicas[bhome].export_session("wire-b")
+        assert pay is not None
+        assert router._replicas[1 - bhome].seed_session(pay) > 0
+        assert router._replicas[bhome].export_session("wire-b") is None
+    finally:
+        router.close()
+    assert store.peek_tier("wire-a") is not None, \
+        "close must persist the resident session into the store"
+    # restart: a fresh subprocess fleet + store over the same dir —
+    # the seeded copy (or the close-persisted one) resumes from disk
+    store.close()
+    store2 = SessionStore(str(tmp_path / "wire"), dram_bytes=1 << 20)
+    router2 = ReplicaRouter(workers=[spec], warmup_lens=(16, 32),
+                            session_store=store2, faults=None)
+    try:
+        router2.warmup()
+        p3 = np.concatenate([p2, np.asarray(rr2.tokens, np.int32),
+                             rng.integers(0, CFG.vocab_size, 3).astype(
+                                 np.int32)])
+        rr3 = router2.submit(p3, max_new_tokens=5,
+                             session_id="wire-a")
+        router2.run_until_idle(max_steps=200000)
+        np.testing.assert_array_equal(rr3.tokens,
+                                      _ref(p3, 5)[p3.size:])
+        st = router2.summary()["sessions"]
+        assert (st["reattach"]["disk"] + st["reattach"]["dram"]
+                + st["fallbacks"]) >= 1
+    finally:
+        router2.close()
+    store2.close()
