@@ -2594,6 +2594,123 @@ def bench_moe() -> dict:
     return result
 
 
+def bench_soak() -> dict:
+    """Chaos soak (ISSUE 19): a subprocess fleet rides a seeded diurnal
+    trace in REAL time (WallClock — arrivals hold their cadence even
+    when a fault slows the fleet) with the autoscaler live and a
+    ChaosSchedule firing rate-based faults the whole run: replica
+    crashes, hangs, slow ticks, and wire-level line mangling between
+    router and worker. serving/soak.py's InvariantChecker watches
+    continuously; the run FAILS (ok=false in the stamp) if any
+    invariant breaks — compliant-tenant sheds, fresh XLA traces on a
+    survivor, a non-terminal stream, an orphan worker process.
+
+    Stamps: SLO attainment over admitted requests, the finish-reason
+    split, the per-fault-class recovery table (injected → detected →
+    recovered with MTTR percentiles), the invariant verdicts and the
+    autoscaler's decisions. ``scripts/soak.py`` wraps this for
+    multi-minute runs; the committed BENCH_soak.json is one such leg.
+
+    Knobs: PTD_SOAK_{DURATION,QPS,PEAK,REPLICAS,MAX_REPLICAS,SEED,
+    FAULTS,SLOTS,QUEUE}; PTD_SOAK_FAULTS takes the full fault grammar
+    (see faults/chaos.py) — the default mixes three replica classes
+    with two wire classes.
+    """
+    import os
+    import tempfile
+
+    from pytorchdistributed_tpu.faults import ChaosSchedule
+    from pytorchdistributed_tpu.serving import (
+        Autoscaler,
+        ReplicaRouter,
+        SLOConfig,
+        TenantConfig,
+        TenantTraffic,
+        WallClock,
+        make_trace,
+        run_soak,
+    )
+
+    duration_s = float(os.environ.get("PTD_SOAK_DURATION", "45.0"))
+    base_qps = float(os.environ.get("PTD_SOAK_QPS", "3.0"))
+    peak_mult = float(os.environ.get("PTD_SOAK_PEAK", "3.0"))
+    replicas = int(os.environ.get("PTD_SOAK_REPLICAS", "2"))
+    max_replicas = int(os.environ.get("PTD_SOAK_MAX_REPLICAS", "3"))
+    num_slots = int(os.environ.get("PTD_SOAK_SLOTS", "4"))
+    max_queue = int(os.environ.get("PTD_SOAK_QUEUE", "24"))
+    seed = int(os.environ.get("PTD_SOAK_SEED", "7"))
+    # >= 3 fault classes incl. wire faults, rates sized so each class
+    # fires a handful of times over the default duration
+    faults_spec = os.environ.get(
+        "PTD_SOAK_FAULTS",
+        "replica_crash@rate=0.05;replica_hang@rate=0.02;"
+        "replica_slow@rate=0.08,ms=150;"
+        "wire_torn@rate=0.05;wire_delay@rate=0.08,ms=100")
+
+    trace = make_trace(
+        seed=seed, duration_s=duration_s, base_qps=base_qps,
+        shape="diurnal", peak_mult=peak_mult,
+        tenants=(TenantTraffic("hot", share=4.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=50257, prompt_cap=24, new_cap=8)
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": num_slots, "prefill_bucket": 16}}
+    clk = WallClock()
+    chaos = ChaosSchedule(faults_spec, seed=seed, clock=clk)
+    tmp = tempfile.mkdtemp(prefix="ptd_soak_")
+    router = ReplicaRouter(
+        workers=[spec] * replicas, warmup_lens=(16, 32),
+        max_queue=max_queue, faults=chaos, respawn_budget=3,
+        seed=seed, telemetry_dir=tmp,
+        tenants={"hot": TenantConfig(weight=1.0),
+                 "calm": TenantConfig(weight=1.0)})
+    router.warmup()
+    asc = Autoscaler(
+        router,
+        SLOConfig(queue_high=8.0, occupancy_high=0.95,
+                  occupancy_low=0.3, shed_rate_max=1.0,
+                  ttft_target_ms=1e9),
+        min_replicas=1, max_replicas=max_replicas,
+        breach_ticks=5, clear_ticks=100,
+        up_cooldown_s=5.0, down_cooldown_s=10.0, clock=clk)
+    report = run_soak(
+        router, trace, clock=clk, tick_s=0.02, autoscaler=asc,
+        compliant=("calm",), debt_budget_s=30.0, strict=False)
+
+    result = {
+        "metric": "soak_slo_attainment",
+        "value": report["slo_attainment"], "unit": "frac",
+        "ok": report["invariants"]["ok"],
+        "duration_s": duration_s,
+        "trace": {"seed": seed, "shape": "diurnal",
+                  "requests": len(trace), "base_qps": base_qps,
+                  "peak_mult": peak_mult},
+        "faults": faults_spec,
+        "replicas": replicas, "max_replicas": max_replicas,
+        **{k: report[k] for k in (
+            "requests", "admitted", "finish_reasons", "ttft_p50_s",
+            "ttft_p95_s", "wall_s", "faults_injected",
+            "injected_by_kind", "recovery", "invariants")},
+        "router": {k: report["router"].get(k) for k in (
+            "submitted", "completed", "shed_requests", "failovers",
+            "redispatched_requests", "quarantines", "rejoins",
+            "respawns", "handoff_aborts", "wire_faults",
+            "faults_injected")},
+    }
+    if "autoscaler" in report:
+        result["autoscaler"] = {
+            k: report["autoscaler"].get(k)
+            for k in ("scale_ups", "scale_downs")}
+    _stamp_overrides(result, ("PTD_SOAK_DURATION", "PTD_SOAK_QPS",
+                              "PTD_SOAK_PEAK", "PTD_SOAK_REPLICAS",
+                              "PTD_SOAK_MAX_REPLICAS", "PTD_SOAK_SLOTS",
+                              "PTD_SOAK_QUEUE", "PTD_SOAK_SEED",
+                              "PTD_SOAK_FAULTS"))
+    return result
+
+
 BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "gpt2medium": functools.partial(bench_gpt2, "medium"),
            "longcontext": functools.partial(
@@ -2604,7 +2721,7 @@ BENCHES = {"gpt2": bench_gpt2, "llama1b": bench_llama1b,
            "serve": bench_serve, "kvcompress": bench_kvcompress,
            "specdraft": bench_specdraft,
            "router": bench_router, "autoscale": bench_autoscale,
-           "sessions": bench_sessions,
+           "sessions": bench_sessions, "soak": bench_soak,
            "disagg": bench_disagg, "coldstart": bench_coldstart,
            "moe": bench_moe,
            "mlp": bench_mlp, "sweep": bench_sweep,

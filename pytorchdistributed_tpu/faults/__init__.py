@@ -9,6 +9,10 @@ Both emit TelemetryEvents so every injection and every retry is durable
 in the run record.
 """
 
+from pytorchdistributed_tpu.faults.chaos import (  # noqa: F401
+    ChaosSchedule,
+    recovery_table,
+)
 from pytorchdistributed_tpu.faults.inject import (  # noqa: F401
     CRASH_EXIT_CODE,
     EXIT_PREEMPTED,

@@ -45,7 +45,19 @@ data loader, and the checkpoint save path already call:
     engine-health tripwire declares it sick and the router quarantines
     it. The router redispatches the victim's in-flight requests to
     survivors — `serving/router.py` owns the application, this module
-    owns the schedule.
+    owns the schedule. Serving faults also accept ``rate=R`` (Poisson
+    events/sec over wall-clock), ``period=P`` (every P seconds) and
+    ``burst=B`` instead of a one-shot ``tick=`` — those specs are inert
+    under the base injector and fire through ``faults.chaos
+    .ChaosSchedule``; ``replica_slow`` stretches a replica's next step
+    by ``ms=`` without tripping the watchdog;
+  * ``wire_corrupt`` / ``wire_torn`` / ``wire_delay`` / ``wire_drop``
+    (``@tick=T|rate=R|period=P|p=P[,replica=I][,ms=M]``) — wire-level
+    faults (ISSUE 19) applied by a ChaosSchedule at the subprocess
+    line-JSON boundary: corrupt mangles a response into invalid JSON,
+    torn truncates it, delay sleeps ``ms=``, drop loses the line (the
+    op must surface via its timeout). The router classifies them as
+    protocol faults → quarantine, never an uncaught raise.
 
 Every injection emits a TelemetryEvent before it acts, so the launcher's
 per-incarnation summaries show *why* an incarnation died. Step-targeted
@@ -92,8 +104,17 @@ _IO_KINDS = ("slow_io", "io_err")
 #: progress-watermark analog of the SIGSTOP training hang), nan poisons
 #: its PARAMS so the engine-health tripwire (params_finite) must declare
 #: it sick and the router quarantine it.
-_SERVING_KINDS = ("replica_crash", "replica_hang", "replica_nan")
-KINDS = frozenset(_STEP_KINDS + _IO_KINDS + _SERVING_KINDS
+_SERVING_KINDS = ("replica_crash", "replica_hang", "replica_nan",
+                  "replica_slow")
+#: Wire-level faults (ISSUE 19): applied at the router↔worker line-JSON
+#: boundary (and the KV-handoff/session payload path) by a ChaosSchedule
+#: (faults/chaos.py) — `wire_corrupt` mangles a response line into
+#: invalid JSON, `wire_torn` truncates it mid-object, `wire_delay`
+#: sleeps ms= before delivery, `wire_drop` loses the line entirely (the
+#: op surfaces only via its timeout — indistinguishable from a hang
+#: until the retry/watchdog machinery classifies it).
+_WIRE_KINDS = ("wire_corrupt", "wire_torn", "wire_delay", "wire_drop")
+KINDS = frozenset(_STEP_KINDS + _IO_KINDS + _SERVING_KINDS + _WIRE_KINDS
                   + ("ckpt_corrupt",))
 
 
@@ -111,6 +132,9 @@ class FaultSpec:
     layer: int | None = None    # nan only: poison THIS layer's params
     tick: int | None = None     # serving faults: fire at router tick T
     replica: int | None = None  # serving faults: target replica index
+    rate: float | None = None   # chaos: Poisson events/sec (wall-clock)
+    period: float | None = None  # chaos: fire every P seconds
+    burst: int = 1              # chaos: victims per firing
 
     def describe(self) -> str:
         parts = [self.kind]
@@ -124,6 +148,12 @@ class FaultSpec:
             parts.append(f"replica={self.replica}")
         if self.layer is not None:
             parts.append(f"layer={self.layer}")
+        if self.rate is not None:
+            parts.append(f"rate={self.rate}")
+        if self.period is not None:
+            parts.append(f"period={self.period}")
+        if self.burst != 1:
+            parts.append(f"burst={self.burst}")
         return parts[0] + ("@" + ",".join(parts[1:]) if parts[1:] else "")
 
 
@@ -158,9 +188,9 @@ class FaultPlan:
                 key, val = key.strip(), val.strip()
                 try:
                     if key in ("step", "rank", "n", "code", "layer",
-                               "tick", "replica"):
+                               "tick", "replica", "burst"):
                         kw[key] = int(val)
-                    elif key in ("p", "ms"):
+                    elif key in ("p", "ms", "rate", "period"):
                         kw[key] = float(val)
                     else:
                         raise ValueError(f"unknown param {key!r}")
@@ -168,20 +198,40 @@ class FaultPlan:
                     raise ValueError(
                         f"bad fault param {item!r} in {entry!r}: {e}"
                     ) from None
+            chaos = kind in _SERVING_KINDS or kind in _WIRE_KINDS
             if "layer" in kw and kind != "nan":
                 raise ValueError(
                     f"layer= only applies to nan faults (got {entry!r})")
             if kind in _STEP_KINDS and "step" not in kw:
                 raise ValueError(
                     f"fault {kind!r} needs step= (got {entry!r})")
-            if kind in _SERVING_KINDS and "tick" not in kw:
+            if (kind in _SERVING_KINDS and "tick" not in kw
+                    and "rate" not in kw and "period" not in kw):
                 raise ValueError(
-                    f"fault {kind!r} needs tick= (got {entry!r})")
-            if (("tick" in kw or "replica" in kw)
-                    and kind not in _SERVING_KINDS):
+                    f"fault {kind!r} needs tick=, rate= or period= "
+                    f"(got {entry!r})")
+            if (kind in _WIRE_KINDS and not any(
+                    k in kw for k in ("tick", "rate", "period", "p"))):
                 raise ValueError(
-                    f"tick=/replica= only apply to serving faults "
-                    f"({', '.join(_SERVING_KINDS)}; got {entry!r})")
+                    f"fault {kind!r} needs tick=, rate=, period= or p= "
+                    f"(got {entry!r})")
+            if ("tick" in kw or "replica" in kw) and not chaos:
+                raise ValueError(
+                    f"tick=/replica= only apply to serving/wire faults "
+                    f"({', '.join(_SERVING_KINDS + _WIRE_KINDS)}; "
+                    f"got {entry!r})")
+            if (("rate" in kw or "period" in kw or "burst" in kw)
+                    and not chaos):
+                raise ValueError(
+                    f"rate=/period=/burst= only apply to serving/wire "
+                    f"faults (got {entry!r})")
+            if "rate" in kw and kw["rate"] < 0:
+                raise ValueError(f"rate must be >= 0, got {kw['rate']}")
+            if "period" in kw and kw["period"] <= 0:
+                raise ValueError(
+                    f"period must be > 0, got {kw['period']}")
+            if "burst" in kw and kw["burst"] < 1:
+                raise ValueError(f"burst must be >= 1, got {kw['burst']}")
             if "p" in kw and not 0.0 <= kw["p"] <= 1.0:
                 raise ValueError(f"p must be in [0, 1], got {kw['p']}")
             specs.append(FaultSpec(kind=kind, **kw))
@@ -201,6 +251,11 @@ class FaultInjector:
     ``PTD_FAULTS_STATE`` contract), else an in-process set. Probabilistic
     specs draw from a Random seeded on (spec string order, rank), so a
     given plan replays identically."""
+
+    #: The spec behind the most recent ``on_serving_tick`` firing, so a
+    #: caller holding only the returned kind string can still read its
+    #: parameters (``replica_slow`` needs ``ms=``).
+    last_fired: FaultSpec | None = None
 
     def __init__(self, plan: FaultPlan, *, rank: int = 0,
                  state_dir: str | None = None, events: EventLog | None = None,
@@ -344,6 +399,7 @@ class FaultInjector:
                 f"[faults] injected {spec.kind} on replica {replica} at "
                 f"serving tick {tick}\n")
             sys.stderr.flush()
+            self.last_fired = spec
             return spec.kind
         return None
 

@@ -44,6 +44,12 @@
                    multi-turn conversations with think-time gaps) and
                    the fake-clock replay()/replay_conversations()
                    drivers the bench and the quick test tier share
+  * soak.py      — chaos soak (ISSUE 19): InvariantChecker (continuous
+                   no-orphans / fairness / SLO-debt / zero-recompile /
+                   all-streams-terminal assertions over a live fleet)
+                   and run_soak(), which rides a seeded diurnal trace
+                   with the autoscaler live and a faults.ChaosSchedule
+                   firing rate-based replica + wire faults
   * sessions.py  — SessionStore (ISSUE 18): the host-DRAM + disk tiers
                    of the persistent-session KV hierarchy (manifest-
                    verified disk sessions, quarantine-on-corruption,
@@ -105,6 +111,11 @@ from pytorchdistributed_tpu.serving.router import (  # noqa: F401
     ReplicaRouter,
     RouterRequest,
     SubprocessReplica,
+    WireFault,
+)
+from pytorchdistributed_tpu.serving.soak import (  # noqa: F401
+    InvariantChecker,
+    run_soak,
 )
 from pytorchdistributed_tpu.serving.telemetry import (  # noqa: F401
     ROUTER_METRICS_FILE,
@@ -125,6 +136,7 @@ from pytorchdistributed_tpu.serving.traffic import (  # noqa: F401
     FakeClock,
     TenantTraffic,
     TrafficRequest,
+    WallClock,
     make_conversations,
     make_trace,
     replay,

@@ -93,7 +93,8 @@ class Autoscaler:
                  pool_bounds: dict[str, tuple[int, int]] | None = None,
                  breach_ticks: int = 3, clear_ticks: int = 8,
                  up_cooldown_s: float = 0.5, down_cooldown_s: float = 2.0,
-                 window: int = 64, clock=time.monotonic):
+                 window: int = 64, hold_on_degraded: bool = True,
+                 clock=time.monotonic):
         if min_replicas < 1:
             raise ValueError(
                 f"min_replicas must be >= 1, got {min_replicas}")
@@ -111,6 +112,7 @@ class Autoscaler:
         self.up_cooldown_s = up_cooldown_s
         self.down_cooldown_s = down_cooldown_s
         self.window = window
+        self.hold_on_degraded = bool(hold_on_degraded)
         self._clock = clock
         self._breach: dict[str, int] = {}
         self._clear: dict[str, int] = {}
@@ -209,6 +211,12 @@ class Autoscaler:
             self._breach[pool] = 0
         else:
             self._breach[pool] = 0
+            self._clear[pool] = 0
+        if (self.hold_on_degraded
+                and (st.get("dead", 0) or st.get("quarantined", 0))):
+            # a degraded fleet can READ as idle (dead replicas serve
+            # nothing); never scale down while recovery is in flight —
+            # chaos soaks hit this constantly
             self._clear[pool] = 0
         now = self._clock()
         lo, hi = self._bounds(pool)

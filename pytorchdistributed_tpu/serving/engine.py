@@ -3008,6 +3008,10 @@ class ServingEngine:
             "pool_free_frac": round(free_frac, 4),
             "ttft_ema_s": self._ttft_ema,
             "sick": self._sick,
+            # process-wide compiled-program census: a soak's invariant
+            # checker watches this NOT grow on survivors (fresh XLA
+            # traces mid-serving mean the warmup contract broke)
+            "trace_count": int(sum(TRACE_COUNTS.values())),
         }
         if self.paged:
             # the disagg signals (ISSUE 12): parked handoffs awaiting

@@ -286,6 +286,7 @@ def main() -> int:
 def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
            on_token, sweep_finished, reply, shutdown) -> int:
     tick = 0
+    slow_ms = 0.0   # injected straggler latency, paid on the next step
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -356,6 +357,16 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                     )
 
                     engine.set_params(nan_params(engine._weights))
+                elif fault == "replica_slow":
+                    spec = getattr(injector, "last_fired", None)
+                    slow_ms += spec.ms if spec is not None else 100.0
+            if slow_ms > 0:
+                # a straggler, not a hang: the step still completes and
+                # the progress watermark advances — just late
+                import time as _time
+
+                _time.sleep(slow_ms / 1e3)
+                slow_ms = 0.0
             engine.step()
             sweep_finished()
             if heartbeat is not None:
@@ -455,6 +466,22 @@ def _serve(engine, heartbeat, injector, rank, delivered, finished, reqs,
                   draft_swaps=engine.draft_swaps)
         elif kind == "probe":
             reply(finite=engine.check_params_finite())
+        elif kind == "inject":
+            # router-side rate-based chaos (ISSUE 19): the ChaosSchedule
+            # lives in the ROUTER process (one seed, one decision
+            # stream), so nan/slow verdicts arrive as a wire op the
+            # worker applies to its own engine. crash/hang never ride
+            # this path — the router kills/SIGSTOPs the process itself.
+            what = op.get("kind")
+            if what == "replica_nan":
+                from pytorchdistributed_tpu.serving.engine import (
+                    nan_params,
+                )
+
+                engine.set_params(nan_params(engine._weights))
+            elif what == "replica_slow":
+                slow_ms += float(op.get("ms", 100.0))
+            reply(ok=True, kind=what)
         elif kind == "export_session":
             # persistent sessions (ISSUE 18): hand a RESIDENT parked
             # session's KV over the wire (cross-replica reattach pull)

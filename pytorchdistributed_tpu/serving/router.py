@@ -142,6 +142,31 @@ class ReplicaCrashed(RuntimeError):
     crash in-process; dead pipe/process for a subprocess worker)."""
 
 
+#: Global per-op wire timeout override (seconds); per-op overrides ride
+#: ``PTD_WIRE_TIMEOUT_<OP>_S`` (op name upper-cased), e.g.
+#: ``PTD_WIRE_TIMEOUT_WARMUP_S=120``. Unset → per-op defaults (warmup
+#: 600 s; everything else max(hang_grace_s, 30 s)).
+WIRE_TIMEOUT_ENV = "PTD_WIRE_TIMEOUT_S"
+#: Soft deadline (seconds): any synchronous wire op slower than this
+#: emits a ``wire_slow`` telemetry event — a *delayed* op is visible
+#: long before the hard timeout declares it a hang.
+WIRE_SOFT_ENV = "PTD_WIRE_SOFT_S"
+
+
+class WireFault(TimeoutError):
+    """A protocol-level fault on a replica's wire: a mangled/torn JSON
+    line, or a response that never arrived inside its op timeout while
+    the worker process is demonstrably alive. Subclasses TimeoutError
+    so every existing call site's ``except (ReplicaCrashed,
+    TimeoutError)`` contains it — a wire fault can NEVER escape a
+    router tick — while new call sites (handoff, dispatch) can catch it
+    first and choose quarantine-and-requeue over declare-dead."""
+
+    def __init__(self, msg: str, *, kind: str = "wire_timeout"):
+        super().__init__(msg)
+        self.kind = kind
+
+
 class RouterRequest:
     """One client-visible request: the router's durable record of
     everything needed to REDISPATCH the stream losslessly — prompt,
@@ -238,6 +263,7 @@ class InProcessReplica:
         self.alive = True
         self._hung = False
         self._crash_next = False
+        self._slow_ms = 0.0
 
     def warmup(self, prompt_lens=None, kv_stream: bool = True) -> None:
         self.engine.warmup(prompt_lens=prompt_lens or self.warmup_lens)
@@ -306,6 +332,12 @@ class InProcessReplica:
                 f"replica {self.index}: injected crash")
         if self._hung:
             return  # frozen: alive, silent, zero progress
+        if self._slow_ms > 0:
+            # a straggler, not a hang: the step completes (progress
+            # advances, the watchdog stays quiet) — it just takes the
+            # injected latency to do so
+            time.sleep(self._slow_ms / 1e3)
+            self._slow_ms = 0.0
         self.engine.step()
 
     def health(self) -> dict:
@@ -324,13 +356,15 @@ class InProcessReplica:
         synchronous in-process probe has no wire to share."""
         return self.engine.check_params_finite()
 
-    def apply_fault(self, kind: str) -> None:
+    def apply_fault(self, kind: str, ms: float = 100.0) -> None:
         if kind == "replica_crash":
             self._crash_next = True
         elif kind == "replica_hang":
             self._hung = True
         elif kind == "replica_nan":
             self.poison_params()
+        elif kind == "replica_slow":
+            self._slow_ms += float(ms)
 
     def set_draft_params(self, params=None, *, checkpoint=None,
                          step=None) -> dict:
@@ -412,6 +446,18 @@ class SubprocessReplica:
     router can never leave an orphan worker."""
 
     faults_in_worker = True
+    #: router-installed ChaosSchedule (or None): consulted on every
+    #: received line so wire faults hit the real recv path, not a mock
+    wire_chaos = None
+    #: router-installed event sink: ``on_wire_event(event, **row)`` —
+    #: wire_fault / wire_slow / wire_retry / wire_timeout land in the
+    #: router telemetry stream with the replica index stamped
+    on_wire_event = None
+    #: hard-timeout defaults per op (seconds); anything absent falls
+    #: back to max(hang_grace_s, 30). Env overrides: WIRE_TIMEOUT_ENV
+    #: globally, ``PTD_WIRE_TIMEOUT_<OP>_S`` per op.
+    OP_TIMEOUTS_S = {"warmup": 600.0, "set_draft_params": 60.0,
+                     "drain": 60.0}
 
     def __init__(self, index: int, spec: dict, *, world_size: int = 1,
                  env: dict | None = None, hang_grace_s: float = 10.0,
@@ -437,6 +483,12 @@ class SubprocessReplica:
                               "ttft_ema_s": None, "sick": False}
         self._pending_op: str | None = None
         self._probe_result: bool | None = None
+        # wire-protocol fault accounting (ISSUE 19): bad lines never
+        # raise out of recv — they set the flag the router's health
+        # sweep converts into a quarantine
+        self.protocol_faults = 0
+        self._protocol_fault = False
+        self.wire_stats: dict[str, int] = collections.Counter()
         # session payloads demoted by the worker, awaiting the router's
         # store-persist sweep: [(sid, tenant, wire_payload), ...]
         self._demoted: list = []
@@ -487,7 +539,10 @@ class SubprocessReplica:
     def _try_recv(self, timeout: float = 0.0) -> dict | None:
         """Non-blocking (or bounded) read of the pending response; None
         when the worker hasn't answered yet — the router moves on and
-        the watermark records the silence."""
+        the watermark records the silence. A line that fails to parse
+        is a PROTOCOL FAULT, not an exception: the flag is set, the
+        line dropped, and the router's health sweep quarantines the
+        replica through the ordinary clean-probe→canary path."""
         if self._pending_op is None:
             return None
         r, _, _ = select.select([self.proc.stdout], [], [], timeout)
@@ -503,22 +558,96 @@ class SubprocessReplica:
             self.alive = False
             raise ReplicaCrashed(f"replica {self.index}: EOF "
                                  f"(code {self.proc.poll()})")
+        if self.wire_chaos is not None:
+            line, fault = self.wire_chaos.mangle_recv(self.index, line)
+            if fault is not None:
+                self.wire_stats[fault] += 1
+                if self.on_wire_event is not None:
+                    self.on_wire_event("wire_fault", fault=fault,
+                                       op=self._pending_op)
+            if line is None:
+                # wire_drop: the response is simply GONE. The op stays
+                # pending — exactly what real message loss looks like —
+                # and surfaces through wait_response's timeout or the
+                # tick loop's progress watermark.
+                return None
+        op = self._pending_op
         self._pending_op = None
-        return json.loads(line)
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            self.protocol_faults += 1
+            self._protocol_fault = True
+            self.wire_stats["bad_lines"] += 1
+            sys.stderr.write(
+                f"[router] replica {self.index}: unparseable wire line "
+                f"for op {op!r} ({len(line)} bytes) — protocol fault\n")
+            return None
 
-    def wait_response(self, timeout: float) -> dict:
-        """Blocking receive for the synchronous phases (warmup, close)
-        where the caller legitimately waits — never used in the
-        steady-state tick loop."""
-        deadline = time.perf_counter() + timeout
+    def _op_timeout(self, op: str | None) -> float:
+        """Hard response deadline for ``op``: per-op env override >
+        global env override > per-op default > max(hang_grace_s, 30)."""
+        if op:
+            v = os.environ.get(f"PTD_WIRE_TIMEOUT_{op.upper()}_S")
+            if v:
+                return float(v)
+        v = os.environ.get(WIRE_TIMEOUT_ENV)
+        if v:
+            return float(v)
+        base = self.OP_TIMEOUTS_S.get(op or "")
+        if base is None:
+            return max(self.hang_grace_s, 30.0)
+        return max(self.hang_grace_s, base)
+
+    def wait_response(self, timeout: float | None = None, *,
+                      op: str | None = None, retries: int = 1) -> dict:
+        """Blocking receive for the synchronous phases (warmup, close,
+        handoffs) where the caller legitimately waits — never used in
+        the steady-state tick loop. ``timeout=None`` resolves the
+        per-op policy (``_op_timeout``); crossing the soft deadline
+        emits one ``wire_slow`` event (a DELAYED op is observable long
+        before it times out); a hard timeout with the worker still
+        alive grants ``retries`` extra window(s) (``wire_retry``)
+        before giving up with WireFault (``wire_timeout``) — a torn
+        line observed while waiting raises WireFault immediately."""
+        op = op or self._pending_op
+        if timeout is None:
+            timeout = self._op_timeout(op)
+        soft = float(os.environ.get(WIRE_SOFT_ENV, "5.0"))
+        faults_before = self.protocol_faults
+        start = time.perf_counter()
+        deadline = start + timeout
+        soft_fired = False
+        retries_left = max(0, int(retries))
         while True:
             resp = self._try_recv(timeout=0.2)
+            if self.protocol_faults > faults_before:
+                raise WireFault(
+                    f"replica {self.index}: protocol fault while "
+                    f"waiting for {op!r}", kind="wire_protocol")
             if resp is not None:
                 return resp
-            if time.perf_counter() > deadline:
-                raise TimeoutError(
+            now = time.perf_counter()
+            if not soft_fired and now - start > soft:
+                soft_fired = True
+                if self.on_wire_event is not None:
+                    self.on_wire_event("wire_slow", op=op,
+                                       waited_s=round(now - start, 3))
+            if now > deadline:
+                if retries_left > 0 and self.proc.poll() is None:
+                    retries_left -= 1
+                    deadline = now + min(timeout, 5.0)
+                    self.wire_stats["retries"] += 1
+                    if self.on_wire_event is not None:
+                        self.on_wire_event("wire_retry", op=op,
+                                           waited_s=round(now - start, 3))
+                    continue
+                if self.on_wire_event is not None:
+                    self.on_wire_event("wire_timeout", op=op,
+                                       waited_s=round(now - start, 3))
+                raise WireFault(
                     f"replica {self.index}: no response within "
-                    f"{timeout}s (op {self._pending_op})")
+                    f"{timeout}s (op {op})")
 
     # -- replica protocol ---------------------------------------------
 
@@ -527,8 +656,9 @@ class SubprocessReplica:
                     "prompt_lens": list(prompt_lens or []),
                     "kv_stream": bool(kv_stream)})
         # first warmup pays the worker's jax import + compiles; the
-        # reply carries the engine's real max_seq_len
-        self._consume(self.wait_response(timeout=600.0))
+        # default 600 s hard deadline is env-tunable (WIRE_TIMEOUT_ENV /
+        # PTD_WIRE_TIMEOUT_WARMUP_S)
+        self._consume(self.wait_response(op="warmup"))
 
     def warmup_async(self, prompt_lens=None, kv_stream: bool = True
                      ) -> None:
@@ -589,7 +719,7 @@ class SubprocessReplica:
         submit refusal and fail a perfectly live stream."""
         self._drain_wire()
         self._send({"op": "preempt", "rid": rr.id})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="preempt")
         self._pending_op = None
         if not resp.get("ok"):
             return False
@@ -615,7 +745,7 @@ class SubprocessReplica:
         self._send({"op": "set_draft_params",
                     "checkpoint": str(checkpoint),
                     "step": step})
-        resp = self.wait_response(max(self.hang_grace_s, 60.0))
+        resp = self.wait_response(op="set_draft_params")
         self._pending_op = None
         if resp.get("ok") is not True:
             raise ValueError(
@@ -634,7 +764,7 @@ class SubprocessReplica:
     def export_kv(self, rr: RouterRequest):
         self._drain_wire()
         self._send({"op": "export_kv", "rid": rr.id})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="export_kv")
         if resp.get("ok") is not True or not resp.get("payload"):
             raise ValueError(
                 f"replica {self.index}: export_kv({rr.id}) refused: "
@@ -649,7 +779,7 @@ class SubprocessReplica:
         self._send({"op": "import_kv", "rid": rr.id,
                     "deadline_s": deadline_s,
                     "payload": kv_payload_to_wire(payload)})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="import_kv")
         if resp.get("ok") is not True:
             return None  # no capacity / mismatch: resume-from-tokens
         m = _Mirror()
@@ -661,7 +791,7 @@ class SubprocessReplica:
         self._drain_wire()
         self._send({"op": "export_prefix",
                     "tokens": [int(t) for t in tokens]})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="export_prefix")
         if resp.get("ok") is not True or not resp.get("payload"):
             return None
         return prefix_payload_from_wire(resp["payload"])
@@ -670,7 +800,7 @@ class SubprocessReplica:
         self._drain_wire()
         self._send({"op": "import_prefix",
                     "payload": prefix_payload_to_wire(payload)})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="import_prefix")
         return int(resp.get("adopted", 0)) if resp.get("ok") else 0
 
     # -- persistent sessions (ISSUE 18) -------------------------------
@@ -681,7 +811,7 @@ class SubprocessReplica:
     def export_session(self, session_id: str):
         self._drain_wire()
         self._send({"op": "export_session", "session_id": session_id})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="export_session")
         if resp.get("ok") is not True or not resp.get("payload"):
             return None
         return kv_payload_from_wire(resp["payload"])
@@ -690,7 +820,7 @@ class SubprocessReplica:
         self._drain_wire()
         self._send({"op": "seed_session",
                     "payload": kv_payload_to_wire(payload)})
-        resp = self.wait_response(max(self.hang_grace_s, 30.0))
+        resp = self.wait_response(op="seed_session")
         return int(resp.get("seeded", 0)) if resp.get("ok") else 0
 
     def take_demoted_sessions(self):
@@ -797,16 +927,43 @@ class SubprocessReplica:
             self._send({"op": "probe"})
         return self._probe_result if self._probe_result is not None else True
 
-    def apply_fault(self, kind: str) -> None:
-        """Subprocess faults ride PTD_FAULTS into the worker itself
-        (it runs the injector against its own RANK) — the router-side
-        application is a no-op here."""
+    def apply_fault(self, kind: str, ms: float = 100.0) -> None:
+        """One-shot tick-targeted faults ride PTD_FAULTS into the
+        worker itself (it runs the injector against its own RANK), but
+        RATE-BASED chaos decisions live router-side (the ChaosSchedule
+        is seeded once, in one process) — so the router plays the
+        cluster: crash kills the process, hang SIGSTOPs it (alive,
+        silent — the watchdog's problem), nan/slow ride a wire op the
+        worker applies to its own engine."""
+        import signal as _signal
+
+        if kind == "replica_crash":
+            self.proc.kill()
+        elif kind == "replica_hang":
+            try:
+                os.kill(self.proc.pid, _signal.SIGSTOP)
+            except (OSError, ProcessLookupError):
+                pass
+        elif kind in ("replica_nan", "replica_slow"):
+            try:
+                self._drain_wire()
+                self._send({"op": "inject", "kind": kind,
+                            "ms": float(ms)})
+                self.wait_response(op="inject")
+                self._pending_op = None
+            except (ReplicaCrashed, TimeoutError):
+                pass  # the health sweep owns the diagnosis
 
     def quarantine_reset(self) -> None:
         try:
             self._drain_wire()
             self._send({"op": "drain"})
-            self._consume(self.wait_response(60.0))
+            self._consume(self.wait_response(op="drain"))
+        except WireFault:
+            # the wire hiccuped DURING the reset: the replica is
+            # already quarantined — the probe streak decides its fate,
+            # no need to escalate a torn line into a death sentence
+            pass
         except (ReplicaCrashed, TimeoutError):
             self.alive = False
 
@@ -1085,6 +1242,24 @@ class ReplicaRouter:
         # explicitly off (bench baseline legs); or a FaultInjector
         self._faults = (faults_inject.active() if faults == "auto"
                         else faults)
+        if (self._faults is not None
+                and not hasattr(self._faults, "mangle_recv")):
+            # a plain injector whose plan carries wire or rate/period
+            # specs needs the ChaosSchedule machinery — upgrade in
+            # place so `PTD_FAULTS="wire_torn@rate=0.1" just works
+            plan = getattr(self._faults, "plan", None)
+            if plan is not None and any(
+                    s.kind in faults_inject._WIRE_KINDS
+                    or s.rate is not None or s.period is not None
+                    for s in plan.specs):
+                from pytorchdistributed_tpu.faults.chaos import (
+                    ChaosSchedule,
+                )
+
+                self._faults = ChaosSchedule(
+                    plan, seed=seed, rank=self._faults.rank,
+                    state_dir=self._faults.state_dir,
+                    events=self._faults.events)
         self._rng = random.Random(seed)
         if telemetry is None:
             # no dir -> RING-ONLY telemetry: zero files, but the signal
@@ -1137,6 +1312,8 @@ class ReplicaRouter:
         self._recovering: list[dict] = []
         self._occ_sum = [0.0 for _ in self._replicas]
         self._occ_n = [0 for _ in self._replicas]
+        for r in self._replicas:
+            self._wire_hooks(r)
         self.reset_stats()
 
     # ------------------------------------------------------------------
@@ -1255,18 +1432,39 @@ class ReplicaRouter:
             self.drain()
             return self._step_stats(0)
         self._ticks += 1
-        # 1. chaos schedule (in-process replicas only: subprocess
-        # workers fire the injector against their own RANK — consulting
-        # it here too would consume the one-shot marker and log an
-        # injection that never happened)
+        # 1. chaos schedule. One-shot tick specs: in-process replicas
+        # only (subprocess workers fire the injector against their own
+        # RANK — consulting it here too would consume the one-shot
+        # marker and log an injection that never happened). RATE-BASED
+        # schedules (ChaosSchedule) are consulted for EVERY replica —
+        # their seeded decisions live router-side, and the router
+        # applies them (kill/SIGSTOP/wire op) playing the cluster —
+        # with ``rate_only`` guarding subprocess one-shots.
         if self._faults is not None:
+            rate_based = getattr(self._faults, "rate_based", False)
             for r in self._replicas:
-                if (self._status[r.index] not in (DEAD, REMOVED)
-                        and not getattr(r, "faults_in_worker", False)):
-                    kind = self._faults.on_serving_tick(self._ticks,
-                                                        r.index)
-                    if kind:
-                        r.apply_fault(kind)
+                if self._status[r.index] in (DEAD, REMOVED):
+                    continue
+                in_worker = getattr(r, "faults_in_worker", False)
+                if in_worker and not rate_based:
+                    continue
+                kind = (self._faults.on_serving_tick(
+                            self._ticks, r.index, rate_only=True)
+                        if in_worker else
+                        self._faults.on_serving_tick(self._ticks,
+                                                     r.index))
+                if kind:
+                    spec = getattr(self._faults, "last_fired", None)
+                    self._stats["faults_injected"] += 1
+                    self._event("fault_injected", replica=r.index,
+                                fault=kind,
+                                spec=(spec.describe() if spec
+                                      else kind))
+                    try:
+                        r.apply_fault(kind, ms=(spec.ms if spec
+                                                else 100.0))
+                    except (ReplicaCrashed, TimeoutError):
+                        self._declare_dead(r, "crashed")
         # 2. health + watchdog + quarantine machine
         self._check_health()
         # 2b. respawn DEAD replicas with budget left (ISSUE 10) —
@@ -1379,6 +1577,21 @@ class ReplicaRouter:
             if not h.get("alive", True):
                 self._declare_dead(r, "crashed")
                 continue
+            # wire protocol fault (ISSUE 19): an unparseable line set
+            # the replica's flag in _try_recv — classify it as SICK
+            # (quarantine → clean-probe streak → canary rejoin, the
+            # same path a NaN'd replica walks), never an uncaught raise
+            if getattr(r, "_protocol_fault", False):
+                r._protocol_fault = False
+                self._stats["wire_faults"] += 1
+                self._event("wire_fault_detected", replica=i,
+                            bad_lines=getattr(r, "protocol_faults", 0))
+                if self._status[i] == HEALTHY:
+                    self._quarantine(r)
+                    continue
+                # already quarantined/draining: the torn line resets
+                # the streak — rejoin must be earned on a clean wire
+                self._clean_probes[i] = 0
             # DRAINING replicas keep the watchdog: a scale-down target
             # that hangs mid-drain must still be shot (its streams fail
             # over) instead of stranding them behind a tombstone-to-be
@@ -1567,16 +1780,33 @@ class ReplicaRouter:
 
     def _build_replacement(self, r):
         if isinstance(r, SubprocessReplica):
-            return SubprocessReplica(
+            fresh = SubprocessReplica(
                 r.index, self._worker_specs[r.index],
                 world_size=len(self._replicas),
                 heartbeat_dir=self._hb_dir,
                 master_port=self._worker_port,
                 env=self._worker_env)
+            self._wire_hooks(fresh)
+            return fresh
         if isinstance(r, InProcessReplica):
             return InProcessReplica(r.index, r._factory,
                                     warmup_lens=r.warmup_lens)
         raise TypeError(f"cannot respawn replica type {type(r).__name__}")
+
+    def _wire_hooks(self, r) -> None:
+        """Install the wire-fault surface on a subprocess replica
+        (fresh fleet, respawn and scale-up alike): the ChaosSchedule
+        mangler when one is active, and the event sink that lands
+        wire_fault/wire_slow/wire_retry/wire_timeout rows in router
+        telemetry with the replica index stamped."""
+        if not isinstance(r, SubprocessReplica):
+            return
+        if (self._faults is not None
+                and hasattr(self._faults, "mangle_recv")):
+            r.wire_chaos = self._faults
+        r.on_wire_event = (
+            lambda ev, _i=r.index, **row: self._event(
+                ev, replica=_i, **row))
 
     def _fleet_unrecoverable(self) -> bool:
         """All replicas DEAD *and* no respawn can ever bring one back —
@@ -1665,6 +1895,7 @@ class ReplicaRouter:
                 i, spec, world_size=i + 1, heartbeat_dir=self._hb_dir,
                 master_port=self._worker_port,
                 env=self._worker_env)
+            self._wire_hooks(fresh)
         else:
             fresh = InProcessReplica(i, self._factory_fn(i),
                                      warmup_lens=self.warmup_lens)
@@ -1854,6 +2085,11 @@ class ReplicaRouter:
         _, rr, idx = best
         try:
             ok = self._replicas[idx].preempt(rr)
+        except WireFault:
+            # the wire mangled the preempt reply: the stream is still
+            # resident and live — skip this round; the protocol-fault
+            # sweep decides the replica's fate
+            return
         except (ReplicaCrashed, TimeoutError):
             self._declare_dead(self._replicas[idx], "crashed")
             return
@@ -2152,6 +2388,18 @@ class ReplicaRouter:
             handle = r.submit(rr, generated=rr.tokens or None,
                               deadline_s=remaining, on_token=cb,
                               prefill_only=prefill_only)
+        except WireFault:
+            # the wire mangled something DURING placement: the replica
+            # is suspect, not dead — requeue the request and let the
+            # protocol-fault sweep quarantine it (no death sentence
+            # for a torn line)
+            self._queue.appendleft(rr)
+            if self.trace is not None and rr.trace is not None:
+                now = time.perf_counter()
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=r.index, why="wire_fault")
+                rr._trace_enq_t = now
+            return False
         except (ReplicaCrashed, TimeoutError):
             # the pick died (or stopped answering) between health check
             # and placement: requeue the request, let the health
@@ -2309,6 +2557,28 @@ class ReplicaRouter:
         t_h0 = time.perf_counter()
         try:
             payload = src.export_kv(rr)
+        except WireFault:
+            # the transfer ABORTED mid-wire (torn/corrupt/lost payload
+            # line): lossless fallback — requeue for re-prefill via
+            # resume-from-tokens; the protocol-fault sweep judges src.
+            # Counted + traced separately from a refused export: an
+            # abort is the wire's fault, not the worker's.
+            del self._assigned[src.index][rr.id]
+            rr._handle = None
+            rr._replica = None
+            rr._eligible_at = 0.0
+            self._queue.appendleft(rr)
+            self._stats["handoff_aborts"] += 1
+            self._event("handoff_aborted", request=rr.id,
+                        from_replica=src.index, to_replica=None,
+                        phase="export")
+            if self.trace is not None and rr.trace is not None:
+                now = time.perf_counter()
+                self.trace.span(rr.trace, "redispatch", now, now,
+                                from_replica=src.index,
+                                why="wire_fault")
+                rr._trace_enq_t = now
+            return
         except (ReplicaCrashed, TimeoutError):
             # rr is still in src's assigned map — _declare_dead's
             # failover requeues it with the rest
@@ -2351,6 +2621,15 @@ class ReplicaRouter:
         try:
             handle = tgt.import_kv(rr, payload, deadline_s=remaining,
                                    on_token=cb)
+        except WireFault:
+            # import reply lost/torn mid-transfer: treat as a refused
+            # import (requeue below) and count the abort — the target's
+            # protocol-fault sweep decides whether it stays in rotation
+            self._stats["handoff_aborts"] += 1
+            self._event("handoff_aborted", request=rr.id,
+                        from_replica=src.index, to_replica=tgt.index,
+                        phase="import")
+            handle = None
         except (ReplicaCrashed, TimeoutError):
             self._declare_dead(tgt, "crashed")
             handle = None
@@ -2466,7 +2745,17 @@ class ReplicaRouter:
         recompile-free on the survivors."""
         lens = prompt_lens or self.warmup_lens
         for r in self._replicas:
-            r.warmup(lens)
+            try:
+                r.warmup(lens)
+            except WireFault as e:
+                # a mangled (or dropped-then-timed-out) warmup reply is
+                # a protocol fault, not a startup abort: the worker is
+                # up and warmed — only the ACK died on the wire. Leave
+                # the replica flagged; the health sweep quarantines it
+                # and the clean-probe→canary path brings it back.
+                self.telemetry.event("wire_fault_detected",
+                                     replica=r.index, op="warmup",
+                                     error=str(e))
         # subprocess workers report their engines' true context bound
         # at warmup — tighten submit validation to the real minimum
         reported = [getattr(r, "reported_max_seq_len", None)
@@ -2695,6 +2984,8 @@ class ReplicaRouter:
                            rejoins=0, hangs_detected=0, replicas_lost=0,
                            respawns=0, respawn_failures=0,
                            handoffs=0, handoff_failures=0,
+                           handoff_aborts=0, wire_faults=0,
+                           faults_injected=0,
                            prefix_ships=0, kv_stream_bytes=0,
                            session_reattach={"hbm": 0, "dram": 0,
                                              "disk": 0},
@@ -2780,6 +3071,9 @@ class ReplicaRouter:
             "roles": list(self._roles),
             "handoffs": st["handoffs"],
             "handoff_failures": st["handoff_failures"],
+            "handoff_aborts": st["handoff_aborts"],
+            "wire_faults": st["wire_faults"],
+            "faults_injected": st["faults_injected"],
             "prefix_ships": st["prefix_ships"],
             "kv_stream_bytes": st["kv_stream_bytes"],
             "cross_replica_hit_rate": (

@@ -119,14 +119,21 @@ class SessionStore:
         stored sessions carrying any other version DECLINE at get()
         (counted, never served). Defaults to the engine's current
         ``KV_WIRE_VERSION``.
-      clock: injectable time source for ages/GC (tests)."""
+      clock: injectable time source for ages/GC (tests).
+      faults: optional ``faults.FaultInjector`` consulted on every
+        disk-tier touch (``on_io``); ``None`` falls back to the
+        process-global ``PTD_FAULTS`` injector. An injected io_err on
+        spill or load is absorbed here — counted as ``io_errors``, the
+        session dropped or missed (re-prefill recovers it) — never a
+        crash."""
 
     def __init__(self, directory: str | pathlib.Path | None = None, *,
                  dram_bytes: int = 256 << 20,
                  disk_bytes: int | None = None,
                  tenants: dict | None = None,
                  wire_version: int | None = None,
-                 clock=None):
+                 clock=None,
+                 faults=None):
         if wire_version is None:
             from pytorchdistributed_tpu.serving.engine import (
                 KV_WIRE_VERSION,
@@ -139,6 +146,7 @@ class SessionStore:
         self.disk_bytes = disk_bytes
         self.wire_version = int(wire_version)
         self._tenants = dict(tenants or {})
+        self._faults = faults
         self._clock = clock or time.time
         self._dram: dict[str, _Record] = {}  # insertion order == LRU
         #: sid -> {"nbytes", "tenant", "time"} for every PUBLISHED disk
@@ -158,7 +166,20 @@ class SessionStore:
                            misses=0, promotes=0, demotes=0,
                            spilled_bytes=0, dropped=0, tenant_evicted=0,
                            quarantined=0, version_declines=0, torn=0,
-                           prefetches=0)
+                           prefetches=0, io_errors=0)
+
+    def _io_hook(self, what: str) -> None:
+        """Consult the fault injector before a disk-tier touch.
+
+        slow_io sleeps here (latency, not failure); io_err raises
+        OSError, which the spill/load call sites absorb."""
+        inj = self._faults
+        if inj is None:
+            from pytorchdistributed_tpu.faults import inject as _inject
+
+            inj = _inject.active()
+        if inj is not None:
+            inj.on_io(what)
 
     def stats(self) -> dict:
         out = dict(self._stats)
@@ -262,8 +283,7 @@ class SessionStore:
             return 0
         n = 0
         for sid, rec in list(self._dram.items()):
-            if not rec.on_disk:
-                self._write_disk(sid, rec)
+            if not rec.on_disk and self._write_disk(sid, rec):
                 n += 1
         self._enforce_disk()
         return n
@@ -309,10 +329,17 @@ class SessionStore:
             del self._dram[sid]
             used -= rec.nbytes
             if self.directory is not None:
-                if not rec.on_disk:
-                    self._write_disk(sid, rec)
+                landed = rec.on_disk
+                if not landed and self._write_disk(sid, rec):
+                    landed = True
                     self._stats["spilled_bytes"] += rec.nbytes
-                self._stats["demotes"] += 1
+                if landed:
+                    self._stats["demotes"] += 1
+                else:
+                    # spill failed (io_err / disk full): the session is
+                    # gone from every tier — a counted drop the client
+                    # recovers from by re-prefilling, never a crash
+                    self._stats["dropped"] += 1
             else:
                 self._stats["dropped"] += 1
         self._enforce_disk()
@@ -351,34 +378,50 @@ class SessionStore:
                 time=float(man.get("time", 0.0)),
                 wire_version=int(man.get("wire_version", 1)))
 
-    def _write_disk(self, session_id: str, rec: _Record) -> None:
+    def _write_disk(self, session_id: str, rec: _Record) -> bool:
+        """Spill one DRAM session to disk; False on I/O failure. A
+        failed spill never publishes (the manifest is the last write),
+        so readers see a torn dir at worst — a miss, never wrong KV."""
         from pytorchdistributed_tpu.serving.engine import (
             kv_payload_to_wire,
         )
 
         sdir = self._session_dir(session_id)
-        sdir.mkdir(parents=True, exist_ok=True)
-        path = sdir / PAYLOAD_NAME
-        tmp = path.with_name(path.name + ".tmp")
-        tmp.write_text(json.dumps(kv_payload_to_wire(rec.payload)))
-        import os
+        try:
+            self._io_hook("session_spill")
+            sdir.mkdir(parents=True, exist_ok=True)
+            path = sdir / PAYLOAD_NAME
+            tmp = path.with_name(path.name + ".tmp")
+            tmp.write_text(json.dumps(kv_payload_to_wire(rec.payload)))
+            import os
 
-        os.replace(tmp, path)
-        # the manifest IS the publish: until it lands, the session is
-        # torn-by-definition and every reader treats it as a miss
-        write_dir_manifest(sdir, extra=dict(
-            session=session_id, tenant=rec.tenant, nbytes=rec.nbytes,
-            wire_version=int(rec.payload.wire_version)))
+            os.replace(tmp, path)
+            # the manifest IS the publish: until it lands, the session
+            # is torn-by-definition and every reader treats it as a miss
+            write_dir_manifest(sdir, extra=dict(
+                session=session_id, tenant=rec.tenant, nbytes=rec.nbytes,
+                wire_version=int(rec.payload.wire_version)))
+        except OSError:
+            self._stats["io_errors"] += 1
+            return False
         rec.on_disk = True
         self._disk[session_id] = dict(
             nbytes=rec.nbytes, tenant=rec.tenant,
             time=float(self._clock()),
             wire_version=int(rec.payload.wire_version))
+        return True
 
     def _load_disk(self, session_id: str):
         """Verify + parse one disk session; None on every decline
         (missing, torn, corrupt→quarantine, version mismatch)."""
         if self.directory is None:
+            return None
+        try:
+            self._io_hook("session_load")
+        except OSError:
+            # transient read failure, NOT corruption evidence: count it
+            # and miss (caller re-prefills); the disk copy stays put
+            self._stats["io_errors"] += 1
             return None
         sdir = self._session_dir(session_id)
         if not sdir.is_dir():

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 import zlib
 
 import numpy as np
@@ -39,6 +40,7 @@ __all__ = [
     "FakeClock",
     "TenantTraffic",
     "TrafficRequest",
+    "WallClock",
     "make_conversations",
     "make_trace",
     "replay",
@@ -63,6 +65,30 @@ class FakeClock:
         if dt < 0:
             raise ValueError(f"clocks only run forward, got dt={dt}")
         self._now += float(dt)
+
+
+class WallClock:
+    """FakeClock's real-time twin for subprocess soaks: ``now()`` is
+    seconds since construction, ``advance(dt)`` sleeps just enough to
+    hold the replay cadence (no sleep at all when the fleet is already
+    behind schedule — a slow tick eats its own budget)."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._target = 0.0
+
+    def now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    __call__ = now
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"clocks only run forward, got dt={dt}")
+        self._target += float(dt)
+        lag = self._target - self.now()
+        if lag > 0:
+            time.sleep(lag)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -488,6 +488,32 @@ def _router_section(run_dir: str) -> list[str]:
                     f"    +{e.get('time', t0) - t0:6.2f}s  "
                     f"{e.get('event', '-'):<14}  "
                     f"replica {e.get('replica', '-')}{detail}")
+        # the chaos recovery table (ISSUE 19): per fault class, how
+        # many injections the schedule fired, how many the router
+        # noticed (dead/quarantine/wire events), how many fully healed
+        # (rejoin), and the injection→recovery MTTR distribution
+        if any(e.get("event") in ("fault_injected", "wire_fault")
+               for e in events):
+            from pytorchdistributed_tpu.faults.chaos import (
+                recovery_table,
+            )
+
+            rec_table = recovery_table(events)
+            if rec_table:
+                lines.append("  fault recovery (per class):")
+                lines.append(
+                    f"    {'fault':>14}  {'injected':>8}  "
+                    f"{'detected':>8}  {'recovered':>9}  "
+                    f"{'mttr_p50':>9}  {'mttr_p95':>9}  {'max':>8}")
+                for kind, row in sorted(rec_table.items()):
+                    def _s(v):
+                        return f"{v:.2f}s" if v is not None else "-"
+                    lines.append(
+                        f"    {kind:>14}  {row['injected']:>8}  "
+                        f"{row['detected']:>8}  {row['recovered']:>9}  "
+                        f"{_s(row['mttr_p50_s']):>9}  "
+                        f"{_s(row['mttr_p95_s']):>9}  "
+                        f"{_s(row['mttr_max_s']):>8}")
     return lines
 
 

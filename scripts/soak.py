@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Long chaos-soak runner (ISSUE 19): `bench.py --mode soak` with the
+knobs as flags instead of env vars, for multi-minute/overnight legs.
+
+    python scripts/soak.py --duration 600 --qps 4 --out BENCH_soak.json
+
+The exit code is the invariant verdict: 0 only when every continuously
+checked invariant held (no orphans, no compliant-tenant sheds, bounded
+SLO debt, zero fresh traces on survivors, all streams terminal) — so a
+soak can gate CI. The full report (per-fault-class MTTR table
+included) lands in --out as one JSON object.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--duration", type=float, default=300.0,
+                   help="soak length in seconds (default 300)")
+    p.add_argument("--qps", type=float, default=3.0)
+    p.add_argument("--peak", type=float, default=3.0,
+                   help="diurnal peak multiplier")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--max-replicas", type=int, default=3)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--faults", type=str, default=None,
+                   help="fault-grammar spec (see faults/chaos.py); "
+                        "default mixes crash/hang/slow + wire faults")
+    p.add_argument("--out", type=str, default="BENCH_soak.json")
+    args = p.parse_args()
+
+    os.environ["PTD_SOAK_DURATION"] = str(args.duration)
+    os.environ["PTD_SOAK_QPS"] = str(args.qps)
+    os.environ["PTD_SOAK_PEAK"] = str(args.peak)
+    os.environ["PTD_SOAK_REPLICAS"] = str(args.replicas)
+    os.environ["PTD_SOAK_MAX_REPLICAS"] = str(args.max_replicas)
+    os.environ["PTD_SOAK_SEED"] = str(args.seed)
+    if args.faults is not None:
+        os.environ["PTD_SOAK_FAULTS"] = args.faults
+
+    from bench import bench_soak
+
+    result = bench_soak()
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    ok = bool(result.get("ok"))
+    print(f"soak: {'PASS' if ok else 'FAIL'}  "
+          f"attainment={result.get('value')}  "
+          f"faults_injected={result.get('faults_injected')}  "
+          f"-> {args.out}")
+    if not ok:
+        for v in result.get("invariants", {}).get("violations", []):
+            print(f"  violation: {v}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,532 @@
+"""Chaos-soaked fleet (ISSUE 19): rate-based fault schedules, wire-level
+fault injection, and the continuously-checked soak invariants.
+
+Three layers, cheapest first:
+
+  * PURE HOST — the extended fault grammar (rate=/period=/burst= +
+    wire kinds) parse/validation walls, ChaosSchedule determinism
+    (same seed + same FakeClock drive -> bit-identical firing log),
+    targeted-vs-random victim selection, the wire manglers, the
+    recovery_table MTTR join and its report rendering, and the
+    autoscaler's hold-while-degraded gate against a stub router.
+  * FAKE-PIPE WIRE — a SubprocessReplica wired to a real os.pipe (no
+    jax worker): a literal torn JSON line is a PROTOCOL FAULT (flag
+    set, nothing raises), wire_drop leaves the op pending like real
+    message loss, and the per-op timeout ladder (env overrides, soft
+    wire_slow deadline, bounded wire_retry, terminal WireFault).
+  * IN-PROCESS JAX — the quick-tier mini-soak twin: a seeded diurnal
+    trace on a FakeClock over 2 replicas with the autoscaler live and
+    a ChaosSchedule firing crash/nan/slow, InvariantChecker strict —
+    zero compliant-tenant sheds, every stream terminal, zero fresh XLA
+    traces, non-empty recovery table. Engine geometry mirrors
+    test_router/test_autoscale so compiles ride the shared jit cache.
+  * SUBPROCESS (full tier only) — a short real soak: wire faults over
+    live run.py-env-contract workers, quarantine->rejoin round trips,
+    zero orphans at close.
+"""
+
+import collections
+import dataclasses
+import functools
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from pytorchdistributed_tpu.faults import (
+    ChaosSchedule,
+    FaultInjector,
+    FaultPlan,
+    recovery_table,
+)
+from pytorchdistributed_tpu.models import GPT2, gpt2_config
+from pytorchdistributed_tpu.serving import (
+    Autoscaler,
+    FakeClock,
+    KVBlockPayload,
+    ReplicaRouter,
+    RouterTelemetry,
+    SamplingParams,
+    SessionStore,
+    SLOConfig,
+    TenantConfig,
+    TenantTraffic,
+    WallClock,
+    WireFault,
+    make_trace,
+    run_soak,
+)
+from pytorchdistributed_tpu.serving import engine as serving_engine
+from pytorchdistributed_tpu.serving.router import SubprocessReplica
+
+CFG = gpt2_config("test", num_layers=2, max_seq_len=64)
+
+
+@functools.cache
+def _setup():
+    model = GPT2(CFG)
+    params = model.init(jax.random.key(1), jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+# ----------------------------------------------------------------------
+# grammar (pure host)
+
+
+def test_chaos_grammar_rate_specs_parse_and_walls():
+    plan = FaultPlan.parse(
+        "replica_crash@rate=0.02;replica_hang@period=2.0,burst=2;"
+        "wire_torn@rate=0.1;wire_drop@p=0.01;replica_slow@tick=5,ms=50")
+    kinds = [s.kind for s in plan.specs]
+    assert kinds == ["replica_crash", "replica_hang", "wire_torn",
+                     "wire_drop", "replica_slow"]
+    assert plan.specs[0].rate == 0.02
+    assert plan.specs[1].period == 2.0 and plan.specs[1].burst == 2
+    # describe() (what fault_injected events stamp) names the trigger
+    assert plan.specs[0].describe() == "replica_crash@rate=0.02"
+    assert plan.specs[1].describe() == "replica_hang@period=2.0,burst=2"
+    with pytest.raises(ValueError, match="rate"):
+        FaultPlan.parse("replica_crash@rate=-1")
+    with pytest.raises(ValueError, match="burst"):
+        FaultPlan.parse("replica_crash@rate=0.1,burst=0")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("io_err@rate=0.1")          # rate is chaos-only
+    with pytest.raises(ValueError):
+        FaultPlan.parse("replica_crash@p=0.5")      # needs a trigger
+    with pytest.raises(ValueError):
+        FaultPlan.parse("wire_torn@ms=5")           # needs a trigger
+
+
+def _drive(sched, *, ticks=60, replicas=3, dt=0.125, clk=None):
+    clk = clk or FakeClock()
+    for t in range(ticks):
+        for r in range(replicas):
+            sched.on_serving_tick(t, r)
+        clk.advance(dt)
+    return sched.injected
+
+
+def test_chaos_schedule_deterministic_and_targeted():
+    def build():
+        clk = FakeClock()
+        return ChaosSchedule("replica_nan@rate=1.0", seed=3,
+                             clock=clk), clk
+
+    s1, c1 = build()
+    s2, c2 = build()
+    log1 = _drive(s1, clk=c1)
+    log2 = _drive(s2, clk=c2)
+    assert log1 == log2 and len(log1) > 0    # bit-identical replay
+    assert all(e["kind"] == "replica_nan" for e in log1)
+
+    # period math is exact on a binary-friendly dt: epoch anchors at the
+    # first consult, then 1.0s / 0.125s = every 8 ticks, burst=2 victims
+    sp = ChaosSchedule("replica_crash@period=1.0,burst=2", seed=3,
+                       clock=(cp := FakeClock()))
+    crashes = _drive(sp, clk=cp)
+    by_tick = collections.Counter(e["tick"] for e in crashes)
+    assert sorted(by_tick) == [8, 16, 24, 32, 40, 48, 56]
+    assert all(n == 2 for n in by_tick.values())
+    assert all(len({e["replica"] for e in crashes if e["tick"] == t}) == 2
+               for t in by_tick)             # distinct victims per burst
+
+    # targeted spec only ever hits its replica
+    st = ChaosSchedule("replica_nan@rate=5.0,replica=1", seed=0,
+                       clock=(ck := FakeClock()))
+    tlog = _drive(st, clk=ck)
+    assert tlog and all(e["replica"] == 1 for e in tlog)
+
+
+def test_mangle_recv_wire_kinds():
+    line = json.dumps({"ok": True, "delivered": [[1, 2]] * 8}) + "\n"
+    for kind in ("wire_corrupt", "wire_torn"):
+        s = ChaosSchedule(f"{kind}@p=1.0", seed=0)
+        out, fault = s.mangle_recv(0, line)
+        assert fault == kind and out is not None and out.endswith("\n")
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(out)
+        assert s.injected[-1]["kind"] == kind
+    s = ChaosSchedule("wire_drop@p=1.0", seed=0)
+    assert s.mangle_recv(0, line) == (None, "wire_drop")
+    s = ChaosSchedule("wire_delay@p=1.0,ms=1", seed=0)
+    out, fault = s.mangle_recv(0, line)
+    assert fault == "wire_delay" and json.loads(out)["ok"] is True
+    # a clean schedule passes lines through untouched
+    s = ChaosSchedule("wire_torn@p=0.0", seed=0)
+    assert s.mangle_recv(0, line) == (line, None)
+    # targeted wire fault leaves other replicas' lines alone
+    s = ChaosSchedule("wire_drop@p=1.0,replica=1", seed=0)
+    assert s.mangle_recv(0, line) == (line, None)
+    assert s.mangle_recv(1, line) == (None, "wire_drop")
+
+
+# ----------------------------------------------------------------------
+# the wire, against a real pipe (no jax worker)
+
+
+def _fake_replica(hang_grace_s=0.2):
+    """A SubprocessReplica whose 'worker' is a bare os.pipe — the recv
+    path (select + readline + parse) is the real code under test."""
+    r = SubprocessReplica.__new__(SubprocessReplica)
+    r.index = 0
+    r.alive = True
+    r.hang_grace_s = hang_grace_s
+    r.heartbeat_path = None
+    r._mirrors, r._on_token, r._demoted = {}, {}, []
+    r._health = {}
+    r._pending_op = None
+    r._probe_result = None
+    r.protocol_faults = 0
+    r._protocol_fault = False
+    r.wire_stats = collections.Counter()
+    rfd, wfd = os.pipe()
+    r.proc = types.SimpleNamespace(stdout=os.fdopen(rfd, "r"),
+                                   poll=lambda: None, pid=os.getpid())
+    return r, os.fdopen(wfd, "w")
+
+
+def test_torn_wire_line_is_protocol_fault_not_crash():
+    """Satellite: garbage on stdout classifies as a replica protocol
+    fault — flagged for the health sweep's quarantine — never an
+    uncaught JSONDecodeError out of the router tick."""
+    r, w = _fake_replica()
+    r._pending_op = "step"
+    w.write('{"ok": true, "delivered": [[1, 42\n')   # literally torn
+    w.flush()
+    assert r._try_recv(timeout=1.0) is None          # no raise
+    assert r._protocol_fault and r.protocol_faults == 1
+    assert r.wire_stats["bad_lines"] == 1
+    assert r.alive and r._pending_op is None         # line consumed
+
+    # a blocking waiter surfaces it as WireFault (kind=wire_protocol),
+    # which every router call site already catches as a TimeoutError
+    r._pending_op = "export_kv"
+    w.write("\x00garbage not json at all\n")
+    w.flush()
+    with pytest.raises(WireFault) as ei:
+        r.wait_response(op="export_kv")
+    assert ei.value.kind == "wire_protocol"
+    assert isinstance(ei.value, TimeoutError)
+
+
+def test_wire_drop_keeps_op_pending():
+    r, w = _fake_replica()
+    r.wire_chaos = ChaosSchedule("wire_drop@p=1.0", seed=0)
+    events = []
+    r.on_wire_event = lambda ev, **row: events.append((ev, row))
+    r._pending_op = "step"
+    w.write('{"ok": true}\n')
+    w.flush()
+    assert r._try_recv(timeout=1.0) is None
+    # the response is GONE but the op is still pending — exactly what
+    # real message loss looks like; the watchdog/timeout owns it now
+    assert r._pending_op == "step"
+    assert r.wire_stats["wire_drop"] == 1
+    assert events == [("wire_fault", {"fault": "wire_drop", "op": "step"})]
+
+
+def test_wire_timeouts_env_overrides_and_soft_deadline(monkeypatch):
+    """Satellite: warmup's hard deadline is policy, not a constant —
+    PTD_WIRE_TIMEOUT_S globally, PTD_WIRE_TIMEOUT_<OP>_S per op — and a
+    DELAYED op is observable (wire_slow) long before the hard timeout
+    kills it (wire_retry -> wire_timeout -> WireFault)."""
+    for var in ("PTD_WIRE_TIMEOUT_S", "PTD_WIRE_TIMEOUT_WARMUP_S",
+                "PTD_WIRE_SOFT_S"):
+        monkeypatch.delenv(var, raising=False)
+    r, w = _fake_replica(hang_grace_s=10.0)
+    assert r._op_timeout("warmup") == 600.0           # the old constant
+    assert r._op_timeout("export_kv") == 30.0         # generic floor
+    monkeypatch.setenv("PTD_WIRE_TIMEOUT_S", "3")
+    assert r._op_timeout("warmup") == 3.0
+    monkeypatch.setenv("PTD_WIRE_TIMEOUT_WARMUP_S", "7.5")
+    assert r._op_timeout("warmup") == 7.5             # per-op wins
+    assert r._op_timeout("export_kv") == 3.0
+
+    monkeypatch.setenv("PTD_WIRE_TIMEOUT_S", "0.4")
+    monkeypatch.setenv("PTD_WIRE_SOFT_S", "0.1")
+    events = []
+    r.on_wire_event = lambda ev, **row: events.append(ev)
+    r._pending_op = "warmup"
+    monkeypatch.delenv("PTD_WIRE_TIMEOUT_WARMUP_S")
+    with pytest.raises(WireFault) as ei:
+        r.wait_response(op="warmup", retries=1)
+    assert ei.value.kind == "wire_timeout"
+    assert events == ["wire_slow", "wire_retry", "wire_timeout"]
+    assert r.wire_stats["retries"] == 1
+
+
+# ----------------------------------------------------------------------
+# session disk tier under injected I/O faults (satellite 2)
+
+
+def _mk_payload(n=16, bs=8):
+    return KVBlockPayload(
+        prompt=np.arange(n, dtype=np.int32), generated=[5],
+        true_len=n, block_size=bs, max_new_tokens=4,
+        sampling=SamplingParams(), stop_ids=(),
+        leaves=[("h0/cached_key",
+                 np.ones((2, n // bs, bs, 4), np.float32))])
+
+
+def test_session_store_io_faults_absorbed_and_fallback(tmp_path):
+    # spill path: two injected io_errs absorbed (counted, session stays
+    # in DRAM), third attempt lands on disk
+    st = SessionStore(str(tmp_path / "a"), dram_bytes=1 << 30,
+                      faults=FaultInjector(
+                          FaultPlan.parse("io_err@p=1.0,n=2")))
+    st.put("s1", _mk_payload())
+    assert st.flush() == 0 and st.stats()["io_errors"] == 1
+    assert st.peek_tier("s1") == "dram"     # nothing lost, nothing torn
+    assert st.flush() == 0 and st.stats()["io_errors"] == 2
+    assert st.flush() == 1                  # injector exhausted (n=2)
+    assert st.peek_tier("s1") == "dram" and "s1" in st._disk
+
+    # load path: a transient read fault is a counted MISS (caller
+    # re-prefills), NOT corruption — the disk copy survives and the
+    # retry serves it
+    st2 = SessionStore(str(tmp_path / "a"), dram_bytes=1 << 30,
+                       faults=FaultInjector(
+                           FaultPlan.parse("io_err@p=1.0,n=1")))
+    assert st2.get("s1") is None
+    s = st2.stats()
+    assert s["io_errors"] == 1 and s["misses"] == 1
+    assert s["quarantined"] == 0            # never evidence of rot
+    got = st2.get("s1")
+    assert got is not None and got[1] == "disk"
+
+    # demotion under a DEAD disk (disk-full story, p=1.0 forever): the
+    # spill fails loudly -> the session drops (counted), never a crash
+    pay = _mk_payload()
+    st3 = SessionStore(str(tmp_path / "b"),
+                       dram_bytes=3 * pay.nbytes // 2,
+                       faults=FaultInjector(
+                           FaultPlan.parse("io_err@p=1.0")))
+    st3.put("x", _mk_payload())
+    st3.put("y", _mk_payload())             # pushes "x" out of DRAM
+    s3 = st3.stats()
+    assert s3["io_errors"] >= 1 and s3["dropped"] == 1
+    assert s3["demotes"] == 0 and s3["spilled_bytes"] == 0
+    assert st3.peek_tier("x") is None and st3.peek_tier("y") == "dram"
+
+
+# ----------------------------------------------------------------------
+# autoscaler: never scale down a degraded fleet
+
+
+class _StubRouter:
+    def __init__(self, healthy=2):
+        self.telemetry = RouterTelemetry(None)
+        self.pool = dict(replicas=healthy, healthy=healthy, draining=0,
+                         quarantined=0, dead=0, removed=0, occupancy=0.1,
+                         free_slots=3, queued=0, prefilling=0, parked=0)
+        self.removed = 0
+        self.trace = None
+
+    def pool_state(self):
+        return {"fleet": dict(self.pool)}
+
+    def add_replica(self, role="both"):
+        self.pool["healthy"] += 1
+        return self.pool["healthy"] - 1
+
+    def remove_replica(self, role=None):
+        self.removed += 1
+        self.pool["healthy"] -= 1
+        return self.pool["healthy"]
+
+
+def test_autoscaler_holds_scaledown_while_degraded():
+    clk = FakeClock()
+    stub = _StubRouter(healthy=3)
+    asc = Autoscaler(stub, SLOConfig(queue_high=100.0), min_replicas=1,
+                     max_replicas=4, breach_ticks=2, clear_ticks=3,
+                     up_cooldown_s=0.1, down_cooldown_s=0.1, clock=clk)
+    stub.telemetry.signal(queue_depth=0, submitted=0, shed=0)
+    # fleet reads idle, but one replica is quarantined (recovery in
+    # flight): the clear streak must never accumulate
+    stub.pool["quarantined"] = 1
+    for _ in range(10):
+        assert asc.step() == []
+        clk.advance(0.2)
+    assert stub.removed == 0
+    stub.pool["quarantined"] = 0            # healed -> downscale resumes
+    for _ in range(4):
+        asc.step()
+        clk.advance(0.2)
+    assert stub.removed == 1
+    # the knob is opt-out for the pre-chaos behavior
+    stub2 = _StubRouter(healthy=3)
+    stub2.pool["dead"] = 1
+    asc2 = Autoscaler(stub2, SLOConfig(queue_high=100.0), min_replicas=1,
+                      max_replicas=4, breach_ticks=2, clear_ticks=3,
+                      up_cooldown_s=0.1, down_cooldown_s=0.1,
+                      hold_on_degraded=False, clock=clk)
+    stub2.telemetry.signal(queue_depth=0, submitted=0, shed=0)
+    for _ in range(4):
+        asc2.step()
+        clk.advance(0.2)
+    assert stub2.removed == 1
+
+
+# ----------------------------------------------------------------------
+# MTTR attribution + report rendering
+
+
+def test_recovery_table_and_report_section(tmp_path):
+    events = [
+        dict(event="fault_injected", time=100.0, replica=0,
+             fault="replica_crash"),
+        dict(event="replica_dead", time=100.5, replica=0),
+        dict(event="respawn", time=101.0, replica=0),
+        dict(event="rejoin", time=103.0, replica=0),
+        dict(event="wire_fault", time=104.0, replica=1,
+             fault="wire_delay"),
+        dict(event="wire_slow", time=104.2, replica=1),
+        # injected but never noticed: counted, not credited
+        dict(event="fault_injected", time=105.0, replica=1,
+             fault="replica_hang"),
+        # someone ELSE's rejoin must not credit replica 1
+        dict(event="rejoin", time=106.0, replica=0),
+    ]
+    t = recovery_table(events)
+    crash = t["replica_crash"]
+    assert (crash["injected"], crash["detected"],
+            crash["recovered"]) == (1, 1, 1)
+    assert crash["mttr_p50_s"] == 3.0 == crash["mttr_max_s"]
+    delay = t["wire_delay"]                 # self-healing class
+    assert delay["recovered"] == 1 and delay["mttr_p50_s"] == 0.2
+    hang = t["replica_hang"]
+    assert (hang["detected"], hang["recovered"]) == (0, 0)
+    assert hang["mttr_p50_s"] is None
+
+    # the telemetry report CLI renders the same join from the router's
+    # event stream on disk
+    from pytorchdistributed_tpu.serving.telemetry import (
+        ROUTER_METRICS_FILE,
+    )
+    from pytorchdistributed_tpu.telemetry.report import _router_section
+
+    with open(tmp_path / ROUTER_METRICS_FILE.format(rank=0), "w") as f:
+        for e in events:
+            f.write(json.dumps({"kind": "event", **e}) + "\n")
+    out = "\n".join(_router_section(str(tmp_path)))
+    assert "fault recovery (per class):" in out
+    assert "replica_crash" in out and "3.00s" in out
+    assert "wire_delay" in out and "0.20s" in out
+
+
+# ----------------------------------------------------------------------
+# the mini-soak twin (quick tier): chaos + autoscaler + invariants
+
+
+def test_mini_soak_invariants_and_fairness_under_chaos(tmp_path):
+    """Satellites 4 + 6: a seeded diurnal trace on a FakeClock over an
+    in-process fleet with crash/nan/slow rates firing and the
+    autoscaler live. InvariantChecker runs STRICT — a compliant-tenant
+    shed, a fresh XLA trace on a survivor, a non-terminal stream or a
+    failed close raises right here. Deterministic: seeded trace,
+    seeded chaos, fake clock."""
+    model, params = _setup()
+    clk = FakeClock()
+    chaos = ChaosSchedule(
+        "replica_crash@rate=0.4;replica_nan@rate=0.4;"
+        "replica_slow@rate=0.7,ms=2",
+        seed=5, clock=clk)
+    trace = make_trace(
+        seed=5, duration_s=2.5, base_qps=6.0, shape="diurnal",
+        peak_mult=2.5,
+        tenants=(TenantTraffic("hot", share=10.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=CFG.vocab_size, prompt_cap=24, new_cap=6)
+    router = ReplicaRouter(
+        model, params, replicas=2,
+        engine_kwargs=dict(num_slots=3, prefill_bucket=16),
+        warmup_lens=(16, 32), max_queue=10, faults=chaos,
+        respawn_budget=2, seed=5,
+        tenants={"hot": TenantConfig(weight=1.0),
+                 "calm": TenantConfig(weight=1.0)})
+    router.warmup()
+    traces0 = sum(serving_engine.TRACE_COUNTS.values())
+    asc = Autoscaler(router,
+                     SLOConfig(queue_high=3.0, occupancy_high=0.9,
+                               occupancy_low=0.5, shed_rate_max=1.0,
+                               ttft_target_ms=1e9),
+                     min_replicas=1, max_replicas=3, breach_ticks=2,
+                     clear_ticks=25, up_cooldown_s=0.3,
+                     down_cooldown_s=0.2, clock=clk)
+    report = run_soak(router, trace, clock=clk, tick_s=0.02,
+                      autoscaler=asc, compliant=("calm",),
+                      debt_budget_s=1000.0, strict=True, check_every=10)
+    inv = report["invariants"]
+    assert inv["ok"] and inv["violations"] == []
+    assert inv["checks"] > 0
+    # chaos actually happened — and the fleet absorbed all of it
+    assert report["faults_injected"] >= 3
+    assert len(report["injected_by_kind"]) >= 2
+    assert report["recovery"], "no fault class made it to the table"
+    detected = sum(r["detected"] for r in report["recovery"].values())
+    assert detected >= 1
+    # every admitted stream terminal; the split accounts for everything
+    assert sum(report["finish_reasons"].values()) == report["requests"]
+    assert report["finish_reasons"].get("stop", 0) \
+        + report["finish_reasons"].get("length", 0) > 0
+    # fairness under chaos: the compliant tenant NEVER pays for it
+    assert inv["shed_by_tenant"].get("calm", 0) == 0
+    assert report["slo_attainment"] is not None
+    # zero fresh XLA traces fleet-wide (respawns ride the jit cache)
+    assert sum(serving_engine.TRACE_COUNTS.values()) == traces0
+
+
+# ----------------------------------------------------------------------
+# the real thing, shortened (full tier only: spawns jax workers)
+
+
+def test_subprocess_soak_short_with_wire_faults(tmp_path):
+    """A compressed BENCH_soak leg: real workers, real wall clock, wire
+    faults on the actual stdout pipes, autoscaler live, strict
+    invariants — zero orphans proven by PID sweep after close."""
+    spec = {"model": "gpt2", "size": "test",
+            "overrides": {"num_layers": 2, "max_seq_len": 64},
+            "init_seed": 1,
+            "engine": {"num_slots": 3, "prefill_bucket": 16}}
+    clk = WallClock()
+    chaos = ChaosSchedule(
+        "replica_crash@rate=0.05;replica_slow@rate=0.25,ms=40;"
+        "wire_torn@rate=0.25;wire_delay@rate=0.4,ms=30",
+        seed=3, clock=clk)
+    trace = make_trace(
+        seed=3, duration_s=8.0, base_qps=2.0, shape="diurnal",
+        peak_mult=2.0,
+        tenants=(TenantTraffic("hot", share=4.0),
+                 TenantTraffic("calm", share=1.0)),
+        vocab_size=50257, prompt_cap=24, new_cap=6)
+    router = ReplicaRouter(
+        workers=[spec, spec], warmup_lens=(16, 32), max_queue=16,
+        faults=chaos, respawn_budget=2, seed=3,
+        telemetry_dir=str(tmp_path),
+        tenants={"hot": TenantConfig(weight=1.0),
+                 "calm": TenantConfig(weight=1.0)})
+    router.warmup()
+    asc = Autoscaler(router,
+                     SLOConfig(queue_high=8.0, occupancy_high=0.95,
+                               occupancy_low=0.3, shed_rate_max=1.0,
+                               ttft_target_ms=1e9),
+                     min_replicas=1, max_replicas=3, breach_ticks=5,
+                     clear_ticks=100, up_cooldown_s=5.0,
+                     down_cooldown_s=10.0, clock=clk)
+    report = run_soak(router, trace, clock=clk, tick_s=0.02,
+                      autoscaler=asc, compliant=("calm",), strict=True)
+    inv = report["invariants"]
+    assert inv["ok"] and inv["violations"] == []
+    assert inv["pids_seen"] >= 2            # the orphan sweep saw them
+    assert report["faults_injected"] >= 1
+    wire = (report["router"]["wire_faults"]
+            + sum(n for k, n in report["injected_by_kind"].items()
+                  if k.startswith("wire_")))
+    assert wire >= 1                        # the wire actually misbehaved
+    assert sum(report["finish_reasons"].values()) == report["requests"]
+    assert inv["shed_by_tenant"].get("calm", 0) == 0
